@@ -1,0 +1,49 @@
+"""ratis-tpu: a TPU-native multi-Raft consensus framework.
+
+A ground-up re-design of the capabilities of Apache Ratis (reference:
+/root/reference, pure Java) for TPU hosts:
+
+- One asyncio host process serves thousands of independent Raft groups
+  ("multi-Raft", cf. reference RaftServerProxy.java:81) behind a single
+  transport endpoint.
+- All per-group mutable consensus scalars (term, role, commitIndex,
+  matchIndex[peers], vote grants, timeout deadlines, lease clocks) live in
+  ``[num_groups, ...]`` device arrays.  Commit advancement
+  (LeaderStateImpl.updateCommit, reference LeaderStateImpl.java:907), vote
+  tallies (LeaderElection.waitForResults, reference LeaderElection.java:498)
+  and failure detection run as single jitted XLA dispatches across the whole
+  group axis instead of per-group threads.
+- Durable state (segmented log files, raft-meta, snapshots) and the network
+  (simulated in-memory queues or gRPC) stay on the host, feeding the device
+  engine with packed event tensors.
+
+Public API mirrors the reference's layering:
+
+- :mod:`ratis_tpu.conf`      — RaftProperties-style configuration.
+- :mod:`ratis_tpu.protocol`  — ids, peers, groups, requests, exceptions.
+- :mod:`ratis_tpu.ops`       — the batched quorum kernels (the point).
+- :mod:`ratis_tpu.server`    — RaftServer / Division runtime.
+- :mod:`ratis_tpu.client`    — RaftClient APIs.
+- :mod:`ratis_tpu.transport` — pluggable RPC (simulated, grpc).
+"""
+
+__version__ = "0.1.0"
+
+from ratis_tpu.protocol.ids import ClientId, RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer, RaftPeerRole
+from ratis_tpu.protocol.group import RaftGroup, RaftGroupMemberId
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.conf.properties import RaftProperties
+
+__all__ = [
+    "ClientId",
+    "Message",
+    "RaftGroup",
+    "RaftGroupId",
+    "RaftGroupMemberId",
+    "RaftPeer",
+    "RaftPeerId",
+    "RaftPeerRole",
+    "RaftProperties",
+    "__version__",
+]

@@ -1,0 +1,2 @@
+from ratis_tpu.conf.properties import Parameters, RaftProperties, parse_size
+from ratis_tpu.conf.keys import RaftClientConfigKeys, RaftConfigKeys, RaftServerConfigKeys

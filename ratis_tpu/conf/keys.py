@@ -1,0 +1,374 @@
+"""Config key catalogs with defaults.
+
+Capability parity with the reference's *ConfigKeys interfaces
+(ratis-server-api/.../RaftServerConfigKeys.java:43-961, RaftClientConfigKeys,
+RaftConfigKeys): PREFIX-composed dotted keys with typed defaults.  Layout
+follows the reference's nested namespaces (Rpc, Log, Log.Appender, Snapshot,
+Read, Write, Watch, RetryCache, LeaderElection, Notification, ThreadPool),
+plus a new `Engine` namespace for the TPU batched-quorum engine.
+"""
+
+from __future__ import annotations
+
+from ratis_tpu.conf.properties import RaftProperties
+from ratis_tpu.util.timeduration import TimeDuration
+
+
+class RaftConfigKeys:
+    PREFIX = "raft"
+
+    class Rpc:
+        TYPE_KEY = "raft.rpc.type"
+        TYPE_DEFAULT = "SIMULATED"  # transports: SIMULATED | GRPC
+
+        @staticmethod
+        def type(p: RaftProperties) -> str:
+            return p.get(RaftConfigKeys.Rpc.TYPE_KEY, RaftConfigKeys.Rpc.TYPE_DEFAULT).upper()
+
+        @staticmethod
+        def set_type(p: RaftProperties, t: str) -> None:
+            p.set(RaftConfigKeys.Rpc.TYPE_KEY, t.upper())
+
+
+class RaftServerConfigKeys:
+    PREFIX = "raft.server"
+
+    STORAGE_DIR_KEY = "raft.server.storage.dir"
+    STORAGE_DIR_DEFAULT = "/tmp/ratis-tpu"
+    STORAGE_FREE_SPACE_MIN_KEY = "raft.server.storage.free-space.min"
+    STORAGE_FREE_SPACE_MIN_DEFAULT = "0MB"
+
+    @staticmethod
+    def storage_dirs(p: RaftProperties) -> list[str]:
+        v = p.get(RaftServerConfigKeys.STORAGE_DIR_KEY,
+                  RaftServerConfigKeys.STORAGE_DIR_DEFAULT)
+        return [s.strip() for s in v.split(",") if s.strip()]
+
+    @staticmethod
+    def set_storage_dir(p: RaftProperties, dirs: "list[str] | str") -> None:
+        if isinstance(dirs, list):
+            dirs = ",".join(dirs)
+        p.set(RaftServerConfigKeys.STORAGE_DIR_KEY, dirs)
+
+    class Rpc:
+        # Election timeout bounds; each follower randomizes in [min, max)
+        # (reference Rpc.TIMEOUT_MIN/MAX, RaftServerConfigKeys.java).
+        TIMEOUT_MIN_KEY = "raft.server.rpc.timeout.min"
+        TIMEOUT_MIN_DEFAULT = TimeDuration.millis(150)
+        TIMEOUT_MAX_KEY = "raft.server.rpc.timeout.max"
+        TIMEOUT_MAX_DEFAULT = TimeDuration.millis(300)
+        REQUEST_TIMEOUT_KEY = "raft.server.rpc.request.timeout"
+        REQUEST_TIMEOUT_DEFAULT = TimeDuration.millis(3000)
+        SLEEP_TIME_KEY = "raft.server.rpc.sleep.time"
+        SLEEP_TIME_DEFAULT = TimeDuration.millis(25)
+        SLOWNESS_TIMEOUT_KEY = "raft.server.rpc.slowness.timeout"
+        SLOWNESS_TIMEOUT_DEFAULT = TimeDuration.valueOf("60s")
+
+        @staticmethod
+        def timeout_min(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.Rpc.TIMEOUT_MIN_KEY,
+                                       RaftServerConfigKeys.Rpc.TIMEOUT_MIN_DEFAULT)
+
+        @staticmethod
+        def timeout_max(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.Rpc.TIMEOUT_MAX_KEY,
+                                       RaftServerConfigKeys.Rpc.TIMEOUT_MAX_DEFAULT)
+
+        @staticmethod
+        def request_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_KEY,
+                                       RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_DEFAULT)
+
+        @staticmethod
+        def slowness_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.Rpc.SLOWNESS_TIMEOUT_KEY,
+                                       RaftServerConfigKeys.Rpc.SLOWNESS_TIMEOUT_DEFAULT)
+
+        @staticmethod
+        def set_timeout(p: RaftProperties, tmin, tmax) -> None:
+            p.set_time_duration(RaftServerConfigKeys.Rpc.TIMEOUT_MIN_KEY, tmin)
+            p.set_time_duration(RaftServerConfigKeys.Rpc.TIMEOUT_MAX_KEY, tmax)
+
+    class Log:
+        USE_MEMORY_KEY = "raft.server.log.use.memory"
+        USE_MEMORY_DEFAULT = False
+        SEGMENT_SIZE_MAX_KEY = "raft.server.log.segment.size.max"
+        SEGMENT_SIZE_MAX_DEFAULT = "8MB"
+        PREALLOCATED_SIZE_KEY = "raft.server.log.preallocated.size"
+        PREALLOCATED_SIZE_DEFAULT = "4MB"
+        WRITE_BUFFER_SIZE_KEY = "raft.server.log.write.buffer.size"
+        WRITE_BUFFER_SIZE_DEFAULT = "64KB"
+        FORCE_SYNC_NUM_KEY = "raft.server.log.force.sync.num"
+        FORCE_SYNC_NUM_DEFAULT = 128
+        UNSAFE_FLUSH_ENABLED_KEY = "raft.server.log.unsafe-flush.enabled"
+        UNSAFE_FLUSH_ENABLED_DEFAULT = False
+        PURGE_GAP_KEY = "raft.server.log.purge.gap"
+        PURGE_GAP_DEFAULT = 1024
+        PURGE_UPTO_SNAPSHOT_INDEX_KEY = "raft.server.log.purge.upto.snapshot.index"
+        PURGE_UPTO_SNAPSHOT_INDEX_DEFAULT = False
+        SEGMENT_CACHE_NUM_MAX_KEY = "raft.server.log.segment.cache.num.max"
+        SEGMENT_CACHE_NUM_MAX_DEFAULT = 6
+        QUEUE_ELEMENT_LIMIT_KEY = "raft.server.log.queue.element-limit"
+        QUEUE_ELEMENT_LIMIT_DEFAULT = 4096
+        QUEUE_BYTE_LIMIT_KEY = "raft.server.log.queue.byte-limit"
+        QUEUE_BYTE_LIMIT_DEFAULT = "64MB"
+
+        @staticmethod
+        def use_memory(p: RaftProperties) -> bool:
+            return p.get_boolean(RaftServerConfigKeys.Log.USE_MEMORY_KEY,
+                                 RaftServerConfigKeys.Log.USE_MEMORY_DEFAULT)
+
+        @staticmethod
+        def set_use_memory(p: RaftProperties, v: bool) -> None:
+            p.set_boolean(RaftServerConfigKeys.Log.USE_MEMORY_KEY, v)
+
+        @staticmethod
+        def segment_size_max(p: RaftProperties) -> int:
+            return p.get_size(RaftServerConfigKeys.Log.SEGMENT_SIZE_MAX_KEY,
+                              RaftServerConfigKeys.Log.SEGMENT_SIZE_MAX_DEFAULT)
+
+        @staticmethod
+        def force_sync_num(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Log.FORCE_SYNC_NUM_KEY,
+                             RaftServerConfigKeys.Log.FORCE_SYNC_NUM_DEFAULT)
+
+        @staticmethod
+        def purge_gap(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Log.PURGE_GAP_KEY,
+                             RaftServerConfigKeys.Log.PURGE_GAP_DEFAULT)
+
+        class Appender:
+            BUFFER_BYTE_LIMIT_KEY = "raft.server.log.appender.buffer.byte-limit"
+            BUFFER_BYTE_LIMIT_DEFAULT = "4MB"
+            BUFFER_ELEMENT_LIMIT_KEY = "raft.server.log.appender.buffer.element-limit"
+            BUFFER_ELEMENT_LIMIT_DEFAULT = 0  # 0 = unlimited
+            SNAPSHOT_CHUNK_SIZE_MAX_KEY = "raft.server.log.appender.snapshot.chunk.size.max"
+            SNAPSHOT_CHUNK_SIZE_MAX_DEFAULT = "16MB"
+            INSTALL_SNAPSHOT_ENABLED_KEY = "raft.server.log.appender.install.snapshot.enabled"
+            INSTALL_SNAPSHOT_ENABLED_DEFAULT = True
+            WAIT_TIME_MIN_KEY = "raft.server.log.appender.wait-time.min"
+            WAIT_TIME_MIN_DEFAULT = TimeDuration.millis(10)
+
+            @staticmethod
+            def buffer_byte_limit(p: RaftProperties) -> int:
+                return p.get_size(
+                    RaftServerConfigKeys.Log.Appender.BUFFER_BYTE_LIMIT_KEY,
+                    RaftServerConfigKeys.Log.Appender.BUFFER_BYTE_LIMIT_DEFAULT)
+
+            @staticmethod
+            def install_snapshot_enabled(p: RaftProperties) -> bool:
+                return p.get_boolean(
+                    RaftServerConfigKeys.Log.Appender.INSTALL_SNAPSHOT_ENABLED_KEY,
+                    RaftServerConfigKeys.Log.Appender.INSTALL_SNAPSHOT_ENABLED_DEFAULT)
+
+    class Snapshot:
+        AUTO_TRIGGER_ENABLED_KEY = "raft.server.snapshot.auto.trigger.enabled"
+        AUTO_TRIGGER_ENABLED_DEFAULT = False
+        AUTO_TRIGGER_THRESHOLD_KEY = "raft.server.snapshot.auto.trigger.threshold"
+        AUTO_TRIGGER_THRESHOLD_DEFAULT = 400000
+        CREATION_GAP_KEY = "raft.server.snapshot.creation.gap"
+        CREATION_GAP_DEFAULT = 1024
+        RETENTION_FILE_NUM_KEY = "raft.server.snapshot.retention.file.num"
+        RETENTION_FILE_NUM_DEFAULT = -1
+
+        @staticmethod
+        def auto_trigger_enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(RaftServerConfigKeys.Snapshot.AUTO_TRIGGER_ENABLED_KEY,
+                                 RaftServerConfigKeys.Snapshot.AUTO_TRIGGER_ENABLED_DEFAULT)
+
+        @staticmethod
+        def auto_trigger_threshold(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Snapshot.AUTO_TRIGGER_THRESHOLD_KEY,
+                             RaftServerConfigKeys.Snapshot.AUTO_TRIGGER_THRESHOLD_DEFAULT)
+
+        @staticmethod
+        def creation_gap(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Snapshot.CREATION_GAP_KEY,
+                             RaftServerConfigKeys.Snapshot.CREATION_GAP_DEFAULT)
+
+        @staticmethod
+        def retention_file_num(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Snapshot.RETENTION_FILE_NUM_KEY,
+                             RaftServerConfigKeys.Snapshot.RETENTION_FILE_NUM_DEFAULT)
+
+    class Read:
+        class Option:
+            DEFAULT = "DEFAULT"  # reads served from leader state directly
+            LINEARIZABLE = "LINEARIZABLE"  # readIndex protocol
+
+        OPTION_KEY = "raft.server.read.option"
+        OPTION_DEFAULT = "DEFAULT"
+        TIMEOUT_KEY = "raft.server.read.timeout"
+        TIMEOUT_DEFAULT = TimeDuration.valueOf("10s")
+        LEADER_LEASE_ENABLED_KEY = "raft.server.read.leader.lease.enabled"
+        LEADER_LEASE_ENABLED_DEFAULT = False
+        LEADER_LEASE_TIMEOUT_RATIO_KEY = "raft.server.read.leader.lease.timeout.ratio"
+        LEADER_LEASE_TIMEOUT_RATIO_DEFAULT = 0.9
+        READ_AFTER_WRITE_CONSISTENT_TIMEOUT_KEY = \
+            "raft.server.read.read-after-write-consistent.write-index-cache.expiry-time"
+        READ_AFTER_WRITE_CONSISTENT_TIMEOUT_DEFAULT = TimeDuration.valueOf("60s")
+
+        @staticmethod
+        def option(p: RaftProperties) -> str:
+            return p.get(RaftServerConfigKeys.Read.OPTION_KEY,
+                         RaftServerConfigKeys.Read.OPTION_DEFAULT).upper()
+
+        @staticmethod
+        def timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.Read.TIMEOUT_KEY,
+                                       RaftServerConfigKeys.Read.TIMEOUT_DEFAULT)
+
+        @staticmethod
+        def leader_lease_enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY,
+                                 RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_DEFAULT)
+
+        @staticmethod
+        def leader_lease_timeout_ratio(p: RaftProperties) -> float:
+            return p.get_float(RaftServerConfigKeys.Read.LEADER_LEASE_TIMEOUT_RATIO_KEY,
+                               RaftServerConfigKeys.Read.LEADER_LEASE_TIMEOUT_RATIO_DEFAULT)
+
+    class Write:
+        ELEMENT_LIMIT_KEY = "raft.server.write.element-limit"
+        ELEMENT_LIMIT_DEFAULT = 4096
+        BYTE_LIMIT_KEY = "raft.server.write.byte-limit"
+        BYTE_LIMIT_DEFAULT = "64MB"
+        FOLLOWER_GAP_RATIO_MAX_KEY = "raft.server.write.follower.gap.ratio.max"
+        FOLLOWER_GAP_RATIO_MAX_DEFAULT = -1.0
+
+        @staticmethod
+        def element_limit(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Write.ELEMENT_LIMIT_KEY,
+                             RaftServerConfigKeys.Write.ELEMENT_LIMIT_DEFAULT)
+
+        @staticmethod
+        def byte_limit(p: RaftProperties) -> int:
+            return p.get_size(RaftServerConfigKeys.Write.BYTE_LIMIT_KEY,
+                              RaftServerConfigKeys.Write.BYTE_LIMIT_DEFAULT)
+
+    class Watch:
+        ELEMENT_LIMIT_KEY = "raft.server.watch.element-limit"
+        ELEMENT_LIMIT_DEFAULT = 65536
+        TIMEOUT_KEY = "raft.server.watch.timeout"
+        TIMEOUT_DEFAULT = TimeDuration.valueOf("10s")
+        TIMEOUT_DENOMINATION_KEY = "raft.server.watch.timeout.denomination"
+        TIMEOUT_DENOMINATION_DEFAULT = TimeDuration.valueOf("1s")
+
+        @staticmethod
+        def timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.Watch.TIMEOUT_KEY,
+                                       RaftServerConfigKeys.Watch.TIMEOUT_DEFAULT)
+
+        @staticmethod
+        def element_limit(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Watch.ELEMENT_LIMIT_KEY,
+                             RaftServerConfigKeys.Watch.ELEMENT_LIMIT_DEFAULT)
+
+    class RetryCache:
+        EXPIRY_TIME_KEY = "raft.server.retrycache.expiry-time"
+        EXPIRY_TIME_DEFAULT = TimeDuration.valueOf("60s")
+        STATISTICS_EXPIRY_TIME_KEY = "raft.server.retrycache.statistics.expiry-time"
+        STATISTICS_EXPIRY_TIME_DEFAULT = TimeDuration.valueOf("100us")
+
+        @staticmethod
+        def expiry_time(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.RetryCache.EXPIRY_TIME_KEY,
+                                       RaftServerConfigKeys.RetryCache.EXPIRY_TIME_DEFAULT)
+
+    class LeaderElection:
+        LEADER_STEP_DOWN_WAIT_TIME_KEY = "raft.server.leaderelection.leader.step-down.wait-time"
+        LEADER_STEP_DOWN_WAIT_TIME_DEFAULT = TimeDuration.valueOf("10s")
+        PRE_VOTE_KEY = "raft.server.leaderelection.pre-vote"
+        PRE_VOTE_DEFAULT = True
+        MEMBER_MAJORITY_ADD_KEY = "raft.server.leaderelection.member.majority.add"
+        MEMBER_MAJORITY_ADD_DEFAULT = False
+
+        @staticmethod
+        def pre_vote(p: RaftProperties) -> bool:
+            return p.get_boolean(RaftServerConfigKeys.LeaderElection.PRE_VOTE_KEY,
+                                 RaftServerConfigKeys.LeaderElection.PRE_VOTE_DEFAULT)
+
+        @staticmethod
+        def step_down_wait_time(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.LeaderElection.LEADER_STEP_DOWN_WAIT_TIME_KEY,
+                RaftServerConfigKeys.LeaderElection.LEADER_STEP_DOWN_WAIT_TIME_DEFAULT)
+
+    class Notification:
+        NO_LEADER_TIMEOUT_KEY = "raft.server.notification.no-leader.timeout"
+        NO_LEADER_TIMEOUT_DEFAULT = TimeDuration.valueOf("60s")
+
+        @staticmethod
+        def no_leader_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Notification.NO_LEADER_TIMEOUT_KEY,
+                RaftServerConfigKeys.Notification.NO_LEADER_TIMEOUT_DEFAULT)
+
+    class Engine:
+        """TPU batched-quorum engine knobs (new; no reference analog — this
+        replaces the reference's thread-per-division daemons)."""
+
+        TICK_INTERVAL_KEY = "raft.tpu.engine.tick-interval"
+        TICK_INTERVAL_DEFAULT = TimeDuration.millis(2)
+        MAX_GROUPS_KEY = "raft.tpu.engine.max-groups"
+        MAX_GROUPS_DEFAULT = 1024
+        MAX_PEERS_KEY = "raft.tpu.engine.max-peers"
+        MAX_PEERS_DEFAULT = 8
+        SCALAR_FALLBACK_THRESHOLD_KEY = "raft.tpu.engine.scalar-fallback-threshold"
+        SCALAR_FALLBACK_THRESHOLD_DEFAULT = 16  # below this many groups, skip device dispatch
+        PLATFORM_KEY = "raft.tpu.engine.platform"
+        PLATFORM_DEFAULT = ""  # "" = jax default platform
+
+        @staticmethod
+        def tick_interval(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftServerConfigKeys.Engine.TICK_INTERVAL_KEY,
+                                       RaftServerConfigKeys.Engine.TICK_INTERVAL_DEFAULT)
+
+        @staticmethod
+        def max_groups(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Engine.MAX_GROUPS_KEY,
+                             RaftServerConfigKeys.Engine.MAX_GROUPS_DEFAULT)
+
+        @staticmethod
+        def max_peers(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Engine.MAX_PEERS_KEY,
+                             RaftServerConfigKeys.Engine.MAX_PEERS_DEFAULT)
+
+
+class RaftClientConfigKeys:
+    PREFIX = "raft.client"
+
+    class Rpc:
+        REQUEST_TIMEOUT_KEY = "raft.client.rpc.request.timeout"
+        REQUEST_TIMEOUT_DEFAULT = TimeDuration.valueOf("3s")
+        WATCH_REQUEST_TIMEOUT_KEY = "raft.client.rpc.watch.request.timeout"
+        WATCH_REQUEST_TIMEOUT_DEFAULT = TimeDuration.valueOf("10s")
+
+        @staticmethod
+        def request_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(RaftClientConfigKeys.Rpc.REQUEST_TIMEOUT_KEY,
+                                       RaftClientConfigKeys.Rpc.REQUEST_TIMEOUT_DEFAULT)
+
+        @staticmethod
+        def watch_request_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftClientConfigKeys.Rpc.WATCH_REQUEST_TIMEOUT_KEY,
+                RaftClientConfigKeys.Rpc.WATCH_REQUEST_TIMEOUT_DEFAULT)
+
+    class Async:
+        OUTSTANDING_REQUESTS_MAX_KEY = "raft.client.async.outstanding-requests.max"
+        OUTSTANDING_REQUESTS_MAX_DEFAULT = 100
+
+        @staticmethod
+        def outstanding_requests_max(p: RaftProperties) -> int:
+            return p.get_int(RaftClientConfigKeys.Async.OUTSTANDING_REQUESTS_MAX_KEY,
+                             RaftClientConfigKeys.Async.OUTSTANDING_REQUESTS_MAX_DEFAULT)
+
+    class MessageStream:
+        SUBMESSAGE_SIZE_KEY = "raft.client.message-stream.submessage-size"
+        SUBMESSAGE_SIZE_DEFAULT = "1MB"
+
+        @staticmethod
+        def submessage_size(p: RaftProperties) -> int:
+            return p.get_size(RaftClientConfigKeys.MessageStream.SUBMESSAGE_SIZE_KEY,
+                              RaftClientConfigKeys.MessageStream.SUBMESSAGE_SIZE_DEFAULT)

@@ -1,0 +1,141 @@
+"""String-keyed typed configuration with ${var} substitution.
+
+Capability parity with the reference's RaftProperties
+(ratis-common/src/main/java/org/apache/ratis/conf/RaftProperties.java:47):
+a mutable map of dotted string keys to string values with typed getters,
+`${other.key}` substitution (RaftProperties.java:149), plus a `Parameters`
+side-channel for non-string objects (TLS configs etc.,
+ratis-common/.../conf/Parameters.java).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, TypeVar
+
+from ratis_tpu.util.timeduration import TimeDuration
+
+_VAR = re.compile(r"\$\{([^}]+)\}")
+_MAX_SUBST = 20
+
+_SIZE_UNITS = {
+    "b": 1, "k": 1 << 10, "kb": 1 << 10, "m": 1 << 20, "mb": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "t": 1 << 40, "tb": 1 << 40,
+}
+
+
+def parse_size(value: "str | int") -> int:
+    """Parse '64KB', '8m', '1gb' -> bytes (cf. reference SizeInBytes.java)."""
+    if isinstance(value, int):
+        return value
+    m = re.match(r"^\s*(-?\d+(?:\.\d+)?)\s*([a-zA-Z]*)\s*$", value)
+    if not m:
+        raise ValueError(f"cannot parse size {value!r}")
+    num, unit = m.groups()
+    if unit and unit.lower() not in _SIZE_UNITS:
+        raise ValueError(f"unknown size unit {unit!r} in {value!r}")
+    mult = _SIZE_UNITS[unit.lower()] if unit else 1
+    return int(float(num) * mult)
+
+
+class RaftProperties:
+    def __init__(self, initial: Optional[dict[str, str]] = None):
+        self._props: dict[str, str] = dict(initial or {})
+
+    # -- raw ------------------------------------------------------------------
+
+    def set(self, key: str, value: Any) -> None:
+        self._props[key] = str(value)
+
+    def unset(self, key: str) -> None:
+        self._props.pop(key, None)
+
+    def get_raw(self, key: str) -> Optional[str]:
+        return self._props.get(key)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        v = self._props.get(key)
+        if v is None:
+            return default
+        return self._substitute(v)
+
+    def _substitute(self, value: str) -> str:
+        for _ in range(_MAX_SUBST):
+            m = _VAR.search(value)
+            if not m:
+                return value
+            ref = self._props.get(m.group(1))
+            if ref is None:
+                return value
+            value = value[:m.start()] + ref + value[m.end():]
+        raise ValueError(f"too many substitutions resolving {value!r}")
+
+    # -- typed getters/setters ----------------------------------------------
+
+    def get_int(self, key: str, default: int) -> int:
+        v = self.get(key)
+        return default if v is None else int(v)
+
+    def set_int(self, key: str, value: int) -> None:
+        self.set(key, int(value))
+
+    def get_float(self, key: str, default: float) -> float:
+        v = self.get(key)
+        return default if v is None else float(v)
+
+    def get_boolean(self, key: str, default: bool) -> bool:
+        v = self.get(key)
+        if v is None:
+            return default
+        return v.strip().lower() in ("true", "1", "yes", "on")
+
+    def set_boolean(self, key: str, value: bool) -> None:
+        self.set(key, "true" if value else "false")
+
+    def get_time_duration(self, key: str, default: "TimeDuration | str") -> TimeDuration:
+        v = self.get(key)
+        return TimeDuration.valueOf(default if v is None else v)
+
+    def set_time_duration(self, key: str, value: "TimeDuration | str") -> None:
+        self.set(key, str(TimeDuration.valueOf(value)))
+
+    def get_size(self, key: str, default: "int | str") -> int:
+        v = self.get(key)
+        return parse_size(default if v is None else v)
+
+    def get_enum(self, key: str, default):
+        v = self.get(key)
+        if v is None:
+            return default
+        return type(default)[v.strip().upper()]
+
+    def items(self):
+        return self._props.items()
+
+    def clone(self) -> "RaftProperties":
+        return RaftProperties(dict(self._props))
+
+    def __len__(self) -> int:
+        return len(self._props)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._props
+
+    def __str__(self) -> str:
+        return f"RaftProperties({len(self._props)} keys)"
+
+
+class Parameters:
+    """Typed non-string attachment map (reference Parameters.java)."""
+
+    def __init__(self):
+        self._map: dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> None:
+        self._map[key] = value
+
+    def get(self, key: str, expected_type: Optional[type] = None) -> Any:
+        v = self._map.get(key)
+        if v is not None and expected_type is not None and not isinstance(v, expected_type):
+            raise TypeError(f"parameter {key}: expected {expected_type}, got {type(v)}")
+        return v

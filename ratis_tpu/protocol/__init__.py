@@ -1,0 +1,5 @@
+from ratis_tpu.protocol.ids import ClientId, RaftGroupId, RaftId, RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer, RaftPeerRole
+from ratis_tpu.protocol.group import RaftGroup, RaftGroupMemberId
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.termindex import TermIndex, INVALID_LOG_INDEX, INVALID_TERM

@@ -1,0 +1,238 @@
+"""The framework exception hierarchy.
+
+Capability parity with the reference's 24 exception types under
+ratis-common/src/main/java/org/apache/ratis/protocol/exceptions/.  These are
+wire-marshallable: a RaftClientReply carries at most one of them, and the
+client's failover/retry logic dispatches on the concrete type (reference
+RaftClientImpl.handleIOException, ratis-client RaftClientImpl.java:412).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from ratis_tpu.protocol.group import RaftGroupMemberId
+    from ratis_tpu.protocol.peer import RaftPeer
+
+
+class RaftException(Exception):
+    """Base of every framework-level failure."""
+
+
+class GroupMismatchException(RaftException):
+    """Request's groupId is not served by this server (RaftServerProxy routing)."""
+
+
+class AlreadyExistsException(RaftException):
+    """Group add for a group already hosted."""
+
+
+class AlreadyClosedException(RaftException):
+    """Operation on a closed server/client/log."""
+
+
+class ServerNotReadyException(RaftException):
+    """Server is still initializing (replaying log / installing snapshot)."""
+
+
+class LeaderNotReadyException(RaftException):
+    """Peer is leader but has not yet committed its startup entry
+    (reference LeaderNotReadyException.java; retried transparently)."""
+
+    member_id = None
+
+    def __init__(self, member_id=None, msg: Optional[str] = None):
+        super().__init__(msg or f"{member_id} is in LEADER state but not ready yet")
+        self.member_id = member_id
+
+
+class NotLeaderException(RaftException):
+    """Request hit a non-leader peer; carries the leader hint + current peers
+    for client failover (reference NotLeaderException.java)."""
+
+    def __init__(self, member_id=None, suggested_leader: "Optional[RaftPeer]" = None,
+                 peers: tuple = ()):
+        hint = f", suggested leader: {suggested_leader}" if suggested_leader else ""
+        super().__init__(f"{member_id} is not the leader{hint}")
+        self.member_id = member_id
+        self.suggested_leader = suggested_leader
+        self.peers = tuple(peers)
+
+
+class LeaderSteppingDownException(RaftException):
+    """Leader rejects new writes while stepping down (transfer leadership)."""
+
+
+class TransferLeadershipException(RaftException):
+    pass
+
+
+class NotReplicatedException(RaftException):
+    """Watch request's desired replication level not reached in time
+    (reference NotReplicatedException.java); carries call id + level + index."""
+
+    def __init__(self, call_id: int = 0, replication=None, log_index: int = -1):
+        super().__init__(
+            f"Request #{call_id} not yet replicated to {replication} (logIndex={log_index})")
+        self.call_id = call_id
+        self.replication = replication
+        self.log_index = log_index
+
+
+class ReconfigurationInProgressException(RaftException):
+    pass
+
+
+class ReconfigurationTimeoutException(RaftException):
+    pass
+
+
+class SetConfigurationException(RaftException):
+    pass
+
+
+class StateMachineException(RaftException):
+    """Application StateMachine raised during startTransaction/apply; leader
+    replies with it (and the entry may still commit) — reference
+    StateMachineException.java."""
+
+    cause = None
+    leader_should_step_down = False
+
+    def __init__(self, msg: str = "", cause: Optional[BaseException] = None,
+                 leader_should_step_down: bool = False):
+        super().__init__(msg or (str(cause) if cause else ""))
+        self.cause = cause
+        self.leader_should_step_down = leader_should_step_down
+
+
+class RaftRetryFailureException(RaftException):
+    """Client exhausted its RetryPolicy."""
+
+    attempt_count = 0
+    cause = None
+
+    def __init__(self, request=None, attempt_count: int = 0, policy=None,
+                 cause: Optional[BaseException] = None):
+        super().__init__(
+            f"Failed {request} for {attempt_count} attempts with {policy}")
+        self.attempt_count = attempt_count
+        self.cause = cause
+
+
+class TimeoutIOException(RaftException):
+    pass
+
+
+class ResourceUnavailableException(RaftException):
+    """Server resource limits hit (pending-request permits, retry-cache size);
+    client backs off (reference ResourceUnavailableException.java)."""
+
+
+class ReadException(RaftException):
+    pass
+
+
+class ReadIndexException(RaftException):
+    pass
+
+
+class StaleReadException(RaftException):
+    """StaleRead's minIndex is beyond this peer's applied index."""
+
+
+class StreamException(RaftException):
+    pass
+
+
+class DataStreamException(RaftException):
+    pass
+
+
+class ChecksumException(RaftException):
+    """CRC mismatch reading a log record (reference ChecksumException.java)."""
+
+    position = -1
+
+    def __init__(self, msg: str, position: int = -1):
+        super().__init__(msg)
+        self.position = position
+
+
+class CorruptedFileException(RaftException):
+    pass
+
+
+class LogCorruptedException(RaftException):
+    pass
+
+
+class InstallSnapshotException(RaftException):
+    pass
+
+
+class LeaderElectionException(RaftException):
+    pass
+
+
+# --- wire marshalling -------------------------------------------------------
+# Exceptions cross the network inside replies; map type name <-> class.
+
+_WIRE_TYPES: dict[str, type] = {
+    cls.__name__: cls
+    for cls in [
+        RaftException, GroupMismatchException, AlreadyExistsException,
+        AlreadyClosedException, ServerNotReadyException, LeaderNotReadyException,
+        NotLeaderException, LeaderSteppingDownException, TransferLeadershipException,
+        NotReplicatedException, ReconfigurationInProgressException,
+        ReconfigurationTimeoutException, SetConfigurationException,
+        StateMachineException, RaftRetryFailureException, TimeoutIOException,
+        ResourceUnavailableException, ReadException, ReadIndexException,
+        StaleReadException, StreamException, DataStreamException,
+        ChecksumException, CorruptedFileException, LogCorruptedException,
+        InstallSnapshotException, LeaderElectionException,
+    ]
+}
+
+
+def exception_to_wire(e: BaseException) -> dict:
+    d: dict = {"type": type(e).__name__ if type(e).__name__ in _WIRE_TYPES else "RaftException",
+               "msg": str(e)}
+    if isinstance(e, NotLeaderException):
+        from ratis_tpu.protocol.peer import RaftPeer
+        if e.suggested_leader is not None:
+            d["suggested_leader"] = e.suggested_leader.to_dict()
+        d["peers"] = [p.to_dict() for p in e.peers]
+    if isinstance(e, NotReplicatedException):
+        d.update(call_id=e.call_id,
+                 replication=None if e.replication is None else int(e.replication),
+                 log_index=e.log_index)
+    return d
+
+
+def exception_from_wire(d: dict) -> RaftException:
+    cls = _WIRE_TYPES.get(d.get("type", ""), RaftException)
+    msg = d.get("msg", "")
+    if cls is NotLeaderException:
+        from ratis_tpu.protocol.peer import RaftPeer
+        leader = d.get("suggested_leader")
+        e: RaftException = NotLeaderException(
+            suggested_leader=RaftPeer.from_dict(leader) if leader else None,
+            peers=tuple(RaftPeer.from_dict(p) for p in d.get("peers", ())))
+        e.args = (msg,)
+        return e
+    if cls is NotReplicatedException:
+        from ratis_tpu.protocol.requests import ReplicationLevel
+        repl = d.get("replication")
+        e = NotReplicatedException(
+            call_id=d.get("call_id", 0),
+            replication=None if repl is None else ReplicationLevel(repl),
+            log_index=d.get("log_index", -1))
+        e.args = (msg,)
+        return e
+    # Generic path: never route msg through a typed first parameter (e.g.
+    # LeaderNotReadyException(member_id), RaftRetryFailureException(request)).
+    e = cls.__new__(cls)
+    Exception.__init__(e, msg)
+    return e

@@ -1,0 +1,65 @@
+"""RaftGroup and RaftGroupMemberId value types.
+
+Capability parity with the reference
+(ratis-common/src/main/java/org/apache/ratis/protocol/RaftGroup.java,
+RaftGroupMemberId.java): a group = groupId + the peer set; a member id =
+(peerId, groupId) naming one division of a multi-Raft server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftGroup:
+    group_id: RaftGroupId
+    peers: tuple[RaftPeer, ...] = ()
+
+    @staticmethod
+    def value_of(group_id: RaftGroupId, peers: Iterable[RaftPeer] = ()) -> "RaftGroup":
+        return RaftGroup(group_id, tuple(peers))
+
+    @staticmethod
+    def empty_group(group_id: Optional[RaftGroupId] = None) -> "RaftGroup":
+        return RaftGroup(group_id or RaftGroupId.empty_id(), ())
+
+    def get_peer(self, peer_id: RaftPeerId) -> Optional[RaftPeer]:
+        for p in self.peers:
+            if p.id == peer_id:
+                return p
+        return None
+
+    def peer_ids(self) -> tuple[RaftPeerId, ...]:
+        return tuple(p.id for p in self.peers)
+
+    def to_dict(self) -> dict:
+        return {"group_id": self.group_id.to_bytes().hex(),
+                "peers": [p.to_dict() for p in self.peers]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "RaftGroup":
+        return RaftGroup(
+            RaftGroupId.value_of(bytes.fromhex(d["group_id"])),
+            tuple(RaftPeer.from_dict(p) for p in d.get("peers", ())),
+        )
+
+    def __str__(self) -> str:
+        return f"{self.group_id}:[{', '.join(str(p) for p in self.peers)}]"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class RaftGroupMemberId:
+    peer_id: RaftPeerId
+    group_id: RaftGroupId
+
+    @staticmethod
+    def value_of(peer_id: RaftPeerId, group_id: RaftGroupId) -> "RaftGroupMemberId":
+        return RaftGroupMemberId(RaftPeerId.value_of(peer_id), group_id)
+
+    def __str__(self) -> str:
+        return f"{self.peer_id}@{self.group_id}"

@@ -1,0 +1,84 @@
+"""UUID-backed protocol identifiers.
+
+Capability parity with the reference's id hierarchy
+(ratis-common/src/main/java/org/apache/ratis/protocol/RaftId.java,
+RaftPeerId.java, RaftGroupId.java, ClientId.java): RaftGroupId and ClientId
+are 16-byte UUIDs; RaftPeerId is an arbitrary UTF-8 string (host-chosen,
+e.g. "s0").  All are immutable and hashable, usable as dict keys and in wire
+messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class RaftId:
+    """Base: a 16-byte UUID identity."""
+
+    uuid: uuid.UUID
+
+    @classmethod
+    def random_id(cls):
+        return cls(uuid.uuid4())
+
+    @classmethod
+    def value_of(cls, value: "str | bytes | uuid.UUID | RaftId"):
+        if isinstance(value, RaftId):
+            return cls(value.uuid)
+        if isinstance(value, uuid.UUID):
+            return cls(value)
+        if isinstance(value, bytes):
+            return cls(uuid.UUID(bytes=value))
+        return cls(uuid.UUID(value))
+
+    @classmethod
+    def empty_id(cls):
+        return cls(uuid.UUID(int=0))
+
+    def to_bytes(self) -> bytes:
+        return self.uuid.bytes
+
+    def is_empty(self) -> bool:
+        return self.uuid.int == 0
+
+    def shorten(self) -> str:
+        return str(self.uuid)[:8]
+
+    def __str__(self) -> str:
+        return str(self.uuid)
+
+
+class RaftGroupId(RaftId):
+    """Identifies one Raft group hosted by a (multi-Raft) server."""
+
+    def __str__(self) -> str:  # group-<uuid> like the reference's display form
+        return f"group-{self.shorten()}"
+
+
+class ClientId(RaftId):
+    def __str__(self) -> str:
+        return f"client-{self.shorten()}"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class RaftPeerId:
+    """String id of one peer (reference RaftPeerId.java:30 stores UTF-8 bytes)."""
+
+    id: str
+
+    @staticmethod
+    def value_of(value: "str | bytes | RaftPeerId") -> "RaftPeerId":
+        if isinstance(value, RaftPeerId):
+            return value
+        if isinstance(value, bytes):
+            return RaftPeerId(value.decode("utf-8"))
+        return RaftPeerId(value)
+
+    def to_bytes(self) -> bytes:
+        return self.id.encode("utf-8")
+
+    def __str__(self) -> str:
+        return self.id

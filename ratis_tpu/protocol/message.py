@@ -1,0 +1,39 @@
+"""Message: the opaque byte payload handed to/returned by a StateMachine.
+
+Capability parity with the reference's Message
+(ratis-common/src/main/java/org/apache/ratis/protocol/Message.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar
+
+
+@dataclasses.dataclass(frozen=True)
+class Message:
+    content: bytes = b""
+
+    EMPTY: ClassVar["Message"]
+
+    @staticmethod
+    def value_of(content: "bytes | str | Message") -> "Message":
+        if isinstance(content, Message):
+            return content
+        if isinstance(content, str):
+            return Message(content.encode("utf-8"))
+        return Message(bytes(content))
+
+    def size(self) -> int:
+        return len(self.content)
+
+    def __str__(self) -> str:
+        if len(self.content) <= 32:
+            try:
+                return f"Message({self.content.decode('utf-8')!r})"
+            except UnicodeDecodeError:
+                pass
+        return f"Message({len(self.content)}B)"
+
+
+Message.EMPTY = Message(b"")

@@ -1,0 +1,85 @@
+"""RaftPeer: one cluster member's identity, address, priority and role.
+
+Capability parity with the reference's RaftPeer
+(ratis-common/src/main/java/org/apache/ratis/protocol/RaftPeer.java): id +
+RPC address (+ optional admin/client/dataStream addresses), an election
+priority, and a startup role (FOLLOWER or LISTENER — listeners replicate but
+never vote nor count toward quorum, RaftPeerRole in Raft.proto:131-137).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from ratis_tpu.protocol.ids import RaftPeerId
+
+
+class RaftPeerRole(enum.IntEnum):
+    """Wire-stable role enum (values mirror Raft.proto RaftPeerRole)."""
+
+    LEADER = 1
+    CANDIDATE = 2
+    FOLLOWER = 3
+    LISTENER = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftPeer:
+    id: RaftPeerId
+    address: str = ""
+    admin_address: Optional[str] = None
+    client_address: Optional[str] = None
+    datastream_address: Optional[str] = None
+    priority: int = 0
+    startup_role: RaftPeerRole = RaftPeerRole.FOLLOWER
+
+    DEFAULT_PRIORITY = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "id", RaftPeerId.value_of(self.id))
+
+    def is_listener(self) -> bool:
+        return self.startup_role == RaftPeerRole.LISTENER
+
+    def get_admin_address(self) -> str:
+        return self.admin_address or self.address
+
+    def get_client_address(self) -> str:
+        return self.client_address or self.address
+
+    def with_priority(self, priority: int) -> "RaftPeer":
+        return dataclasses.replace(self, priority=priority)
+
+    def to_dict(self) -> dict:
+        d = {"id": self.id.id, "address": self.address}
+        if self.priority:
+            d["priority"] = self.priority
+        if self.startup_role != RaftPeerRole.FOLLOWER:
+            d["startup_role"] = int(self.startup_role)
+        for k in ("admin_address", "client_address", "datastream_address"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "RaftPeer":
+        return RaftPeer(
+            id=RaftPeerId.value_of(d["id"]),
+            address=d.get("address", ""),
+            admin_address=d.get("admin_address"),
+            client_address=d.get("client_address"),
+            datastream_address=d.get("datastream_address"),
+            priority=d.get("priority", 0),
+            startup_role=RaftPeerRole(d.get("startup_role", int(RaftPeerRole.FOLLOWER))),
+        )
+
+    def __str__(self) -> str:
+        s = f"{self.id}|{self.address or '-'}"
+        if self.priority:
+            s += f"|priority={self.priority}"
+        if self.startup_role == RaftPeerRole.LISTENER:
+            s += "|listener"
+        return s

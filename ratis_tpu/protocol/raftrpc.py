@@ -1,0 +1,318 @@
+"""Server-to-server Raft RPC messages.
+
+Capability parity with the reference wire format (Raft.proto):
+RequestVoteRequestProto:161 (with preVote flag), AppendEntriesRequestProto:180
+(batched entries + leaderCommit + commitInfos), AppendEntriesReplyProto with
+SUCCESS/NOT_LEADER/INCONSISTENCY results, InstallSnapshotRequestProto:208
+(chunked SnapshotChunkProto mode and notification mode),
+ReadIndexRequestProto:245, StartLeaderElectionRequestProto (leader transfer).
+All messages carry (requestorId, replyId, groupId) routing like
+RaftRpcRequestProto:140.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import msgpack
+
+from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.logentry import LogEntry
+from ratis_tpu.protocol.termindex import TermIndex
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftRpcHeader:
+    """(requestor, reply-to, group) routing triple on every server RPC."""
+
+    requestor_id: RaftPeerId
+    reply_id: RaftPeerId
+    group_id: RaftGroupId
+    call_id: int = 0
+
+    def to_dict(self) -> dict:
+        return {"rq": self.requestor_id.id, "rp": self.reply_id.id,
+                "g": self.group_id.to_bytes(), "c": self.call_id}
+
+    @staticmethod
+    def from_dict(d: dict) -> "RaftRpcHeader":
+        return RaftRpcHeader(RaftPeerId.value_of(d["rq"]),
+                             RaftPeerId.value_of(d["rp"]),
+                             RaftGroupId.value_of(d["g"]), d.get("c", 0))
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestVoteRequest:
+    header: RaftRpcHeader
+    candidate_term: int
+    candidate_last_entry: TermIndex
+    pre_vote: bool = False
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "t": self.candidate_term,
+                "lt": self.candidate_last_entry.term,
+                "li": self.candidate_last_entry.index, "pv": self.pre_vote}
+
+    @staticmethod
+    def from_dict(d: dict) -> "RequestVoteRequest":
+        return RequestVoteRequest(RaftRpcHeader.from_dict(d["h"]), d["t"],
+                                  TermIndex(d["lt"], d["li"]), d.get("pv", False))
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestVoteReply:
+    header: RaftRpcHeader
+    term: int
+    granted: bool
+    should_shutdown: bool = False
+    # Replier's log-up-to-dateness hint used by the candidate's priority logic.
+    last_entry: TermIndex = TermIndex.INITIAL_VALUE
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "t": self.term, "g": self.granted,
+                "sd": self.should_shutdown,
+                "lt": self.last_entry.term, "li": self.last_entry.index}
+
+    @staticmethod
+    def from_dict(d: dict) -> "RequestVoteReply":
+        return RequestVoteReply(RaftRpcHeader.from_dict(d["h"]), d["t"], d["g"],
+                                d.get("sd", False),
+                                TermIndex(d.get("lt", -1), d.get("li", -1)))
+
+
+class AppendResult(enum.IntEnum):
+    """AppendEntriesReplyProto.AppendResult (Raft.proto:189-193)."""
+
+    SUCCESS = 0
+    NOT_LEADER = 1
+    INCONSISTENCY = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendEntriesRequest:
+    header: RaftRpcHeader
+    leader_term: int
+    previous: Optional[TermIndex]
+    entries: tuple[LogEntry, ...]
+    leader_commit: int
+    initializing: bool = False  # bootstrapping a newly-staged peer
+    commit_infos: tuple[tuple[str, int], ...] = ()
+
+    def is_heartbeat(self) -> bool:
+        return not self.entries
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "t": self.leader_term,
+                "pt": -1 if self.previous is None else self.previous.term,
+                "pi": -1 if self.previous is None else self.previous.index,
+                "e": [e.to_dict() for e in self.entries],
+                "lc": self.leader_commit, "init": self.initializing,
+                "ci": [list(x) for x in self.commit_infos]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "AppendEntriesRequest":
+        prev = None if d["pi"] < 0 and d["pt"] < 0 else TermIndex(d["pt"], d["pi"])
+        return AppendEntriesRequest(
+            RaftRpcHeader.from_dict(d["h"]), d["t"], prev,
+            tuple(LogEntry.from_dict(e) for e in d["e"]), d["lc"],
+            d.get("init", False),
+            tuple(tuple(x) for x in d.get("ci", ())))
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendEntriesReply:
+    header: RaftRpcHeader
+    term: int
+    result: AppendResult
+    next_index: int
+    follower_commit: int
+    match_index: int
+    is_heartbeat: bool = False
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "t": self.term, "r": int(self.result),
+                "ni": self.next_index, "fc": self.follower_commit,
+                "mi": self.match_index, "hb": self.is_heartbeat}
+
+    @staticmethod
+    def from_dict(d: dict) -> "AppendEntriesReply":
+        return AppendEntriesReply(RaftRpcHeader.from_dict(d["h"]), d["t"],
+                                  AppendResult(d["r"]), d["ni"], d["fc"],
+                                  d["mi"], d.get("hb", False))
+
+
+class InstallSnapshotResult(enum.IntEnum):
+    """InstallSnapshotReplyProto.InstallSnapshotResult (Raft.proto:225-233)."""
+
+    SUCCESS = 0
+    NOT_LEADER = 1
+    IN_PROGRESS = 2
+    ALREADY_INSTALLED = 3
+    CONF_MISMATCH = 4
+    SNAPSHOT_INSTALLED = 5
+    SNAPSHOT_UNAVAILABLE = 6
+    SNAPSHOT_EXPIRED = 7
+
+
+@dataclasses.dataclass(frozen=True)
+class FileChunk:
+    """One chunk of one snapshot file (FileChunkProto:150-158)."""
+
+    filename: str
+    total_size: int
+    file_digest: bytes
+    chunk_index: int
+    offset: int
+    data: bytes
+    done: bool
+
+    def to_dict(self) -> dict:
+        return {"f": self.filename, "ts": self.total_size, "dg": self.file_digest,
+                "ci": self.chunk_index, "o": self.offset, "d": self.data,
+                "dn": self.done}
+
+    @staticmethod
+    def from_dict(d: dict) -> "FileChunk":
+        return FileChunk(d["f"], d["ts"], d["dg"], d["ci"], d["o"], d["d"], d["dn"])
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotRequest:
+    header: RaftRpcHeader
+    leader_term: int
+    # chunked mode (SnapshotChunkProto:214-221)
+    request_id: str = ""
+    request_index: int = 0
+    snapshot_term_index: Optional[TermIndex] = None
+    chunks: tuple[FileChunk, ...] = ()
+    total_size: int = 0
+    done: bool = False
+    # notification mode (NotificationProto:222-224): leader log purged; the
+    # StateMachine fetches state out-of-band.
+    notification_first_available: Optional[TermIndex] = None
+    last_included: Optional[TermIndex] = None
+
+    def is_notification(self) -> bool:
+        return self.notification_first_available is not None
+
+    def to_dict(self) -> dict:
+        def ti(x):
+            return None if x is None else [x.term, x.index]
+        return {"h": self.header.to_dict(), "t": self.leader_term,
+                "rid": self.request_id, "ridx": self.request_index,
+                "sti": ti(self.snapshot_term_index),
+                "ch": [c.to_dict() for c in self.chunks], "ts": self.total_size,
+                "dn": self.done, "nfa": ti(self.notification_first_available),
+                "lin": ti(self.last_included)}
+
+    @staticmethod
+    def from_dict(d: dict) -> "InstallSnapshotRequest":
+        def ti(x):
+            return None if x is None else TermIndex(x[0], x[1])
+        return InstallSnapshotRequest(
+            RaftRpcHeader.from_dict(d["h"]), d["t"], d.get("rid", ""),
+            d.get("ridx", 0), ti(d.get("sti")),
+            tuple(FileChunk.from_dict(c) for c in d.get("ch", ())),
+            d.get("ts", 0), d.get("dn", False), ti(d.get("nfa")), ti(d.get("lin")))
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotReply:
+    header: RaftRpcHeader
+    term: int
+    result: InstallSnapshotResult
+    request_index: int = 0
+    snapshot_index: int = -1
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "t": self.term, "r": int(self.result),
+                "ri": self.request_index, "si": self.snapshot_index}
+
+    @staticmethod
+    def from_dict(d: dict) -> "InstallSnapshotReply":
+        return InstallSnapshotReply(RaftRpcHeader.from_dict(d["h"]), d["t"],
+                                    InstallSnapshotResult(d["r"]),
+                                    d.get("ri", 0), d.get("si", -1))
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadIndexRequest:
+    header: RaftRpcHeader
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict()}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ReadIndexRequest":
+        return ReadIndexRequest(RaftRpcHeader.from_dict(d["h"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadIndexReply:
+    header: RaftRpcHeader
+    ok: bool
+    read_index: int = -1
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "ok": self.ok, "ri": self.read_index}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ReadIndexReply":
+        return ReadIndexReply(RaftRpcHeader.from_dict(d["h"]), d["ok"],
+                              d.get("ri", -1))
+
+
+@dataclasses.dataclass(frozen=True)
+class StartLeaderElectionRequest:
+    """Leader -> chosen follower during transfer leadership
+    (StartLeaderElectionRequestProto)."""
+
+    header: RaftRpcHeader
+    leader_last_entry: TermIndex
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "lt": self.leader_last_entry.term,
+                "li": self.leader_last_entry.index}
+
+    @staticmethod
+    def from_dict(d: dict) -> "StartLeaderElectionRequest":
+        return StartLeaderElectionRequest(RaftRpcHeader.from_dict(d["h"]),
+                                          TermIndex(d["lt"], d["li"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class StartLeaderElectionReply:
+    header: RaftRpcHeader
+    accepted: bool
+
+    def to_dict(self) -> dict:
+        return {"h": self.header.to_dict(), "ok": self.accepted}
+
+    @staticmethod
+    def from_dict(d: dict) -> "StartLeaderElectionReply":
+        return StartLeaderElectionReply(RaftRpcHeader.from_dict(d["h"]), d["ok"])
+
+
+# --- generic envelope for transports ---------------------------------------
+
+_MSG_TYPES: dict[str, type] = {
+    "vote_req": RequestVoteRequest, "vote_rep": RequestVoteReply,
+    "append_req": AppendEntriesRequest, "append_rep": AppendEntriesReply,
+    "snap_req": InstallSnapshotRequest, "snap_rep": InstallSnapshotReply,
+    "readidx_req": ReadIndexRequest, "readidx_rep": ReadIndexReply,
+    "sle_req": StartLeaderElectionRequest, "sle_rep": StartLeaderElectionReply,
+}
+_TYPE_TAGS = {v: k for k, v in _MSG_TYPES.items()}
+
+
+def encode_rpc(msg) -> bytes:
+    """Tagged msgpack envelope (cf. Netty.proto's request/reply union:31-48)."""
+    return msgpack.packb({"_": _TYPE_TAGS[type(msg)], "b": msg.to_dict()},
+                         use_bin_type=True)
+
+
+def decode_rpc(b: bytes):
+    d = msgpack.unpackb(b, raw=False)
+    return _MSG_TYPES[d["_"]].from_dict(d["b"])

@@ -1,0 +1,225 @@
+"""Client request/reply value types.
+
+Capability parity with the reference's RaftClientRequest (typed sub-requests
+write / read / staleRead / watch / messageStream / dataStream / forward,
+Raft.proto:285-313 and
+ratis-common/src/main/java/org/apache/ratis/protocol/RaftClientRequest.java)
+and RaftClientReply (success/exception/logIndex/commitInfos,
+RaftClientReply.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import msgpack
+
+from ratis_tpu.protocol.exceptions import (RaftException, exception_from_wire,
+                                           exception_to_wire)
+from ratis_tpu.protocol.ids import ClientId, RaftGroupId
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.message import Message
+
+
+class ReplicationLevel(enum.IntEnum):
+    """Watch replication levels (Raft.proto ReplicationLevel:124-129)."""
+
+    MAJORITY = 0
+    ALL = 1
+    MAJORITY_COMMITTED = 2
+    ALL_COMMITTED = 3
+
+
+class RequestType(enum.IntEnum):
+    WRITE = 1
+    READ = 2
+    STALE_READ = 3
+    WATCH = 4
+    MESSAGE_STREAM = 5
+    DATA_STREAM = 6
+    FORWARD = 7
+
+
+@dataclasses.dataclass(frozen=True)
+class TypeCase:
+    """The typed sub-request payload union."""
+
+    type: RequestType
+    # READ: nonlinearizable reads allowed if read policy permits
+    read_nonlinearizable: bool = False
+    read_after_write_consistent: bool = False
+    # STALE_READ: min applied index the serving peer must have
+    stale_read_min_index: int = 0
+    # WATCH
+    watch_index: int = 0
+    watch_replication: ReplicationLevel = ReplicationLevel.MAJORITY
+    # MESSAGE_STREAM
+    stream_id: int = 0
+    message_id: int = 0
+    end_of_request: bool = False
+
+
+def write_request_type() -> TypeCase:
+    return TypeCase(RequestType.WRITE)
+
+
+def read_request_type(nonlinearizable: bool = False,
+                      read_after_write_consistent: bool = False) -> TypeCase:
+    return TypeCase(RequestType.READ, read_nonlinearizable=nonlinearizable,
+                    read_after_write_consistent=read_after_write_consistent)
+
+
+def stale_read_request_type(min_index: int) -> TypeCase:
+    return TypeCase(RequestType.STALE_READ, stale_read_min_index=min_index)
+
+
+def watch_request_type(index: int, replication: ReplicationLevel) -> TypeCase:
+    return TypeCase(RequestType.WATCH, watch_index=index,
+                    watch_replication=replication)
+
+
+def message_stream_request_type(stream_id: int, message_id: int,
+                                end_of_request: bool) -> TypeCase:
+    return TypeCase(RequestType.MESSAGE_STREAM, stream_id=stream_id,
+                    message_id=message_id, end_of_request=end_of_request)
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftClientRequest:
+    client_id: ClientId
+    server_id: RaftPeerId
+    group_id: RaftGroupId
+    call_id: int
+    message: Message = Message.EMPTY
+    type: TypeCase = dataclasses.field(default_factory=write_request_type)
+    slider_seq_num: int = -1  # ordered-async sliding window sequence number
+    timeout_ms: float = 3000.0
+    # Piggybacked already-replied call ids for server retry-cache GC
+    # (reference RaftClientImpl.RepliedCallIds, RaftClientImpl.java:128).
+    replied_call_ids: tuple[int, ...] = ()
+
+    def is_write(self) -> bool:
+        return self.type.type == RequestType.WRITE
+
+    def is_read(self) -> bool:
+        return self.type.type == RequestType.READ
+
+    def is_watch(self) -> bool:
+        return self.type.type == RequestType.WATCH
+
+    def to_dict(self) -> dict:
+        t = self.type
+        return {
+            "cid": self.client_id.to_bytes(), "sid": self.server_id.id,
+            "gid": self.group_id.to_bytes(), "call": self.call_id,
+            "msg": self.message.content, "seq": self.slider_seq_num,
+            "to": self.timeout_ms, "rcids": list(self.replied_call_ids),
+            "t": {"t": int(t.type), "rnl": t.read_nonlinearizable,
+                  "raw": t.read_after_write_consistent,
+                  "smi": t.stale_read_min_index, "wi": t.watch_index,
+                  "wr": int(t.watch_replication), "si": t.stream_id,
+                  "mi": t.message_id, "eor": t.end_of_request},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "RaftClientRequest":
+        t = d["t"]
+        return RaftClientRequest(
+            client_id=ClientId.value_of(d["cid"]),
+            server_id=RaftPeerId.value_of(d["sid"]),
+            group_id=RaftGroupId.value_of(d["gid"]),
+            call_id=d["call"], message=Message(d["msg"]),
+            slider_seq_num=d.get("seq", -1), timeout_ms=d.get("to", 3000.0),
+            replied_call_ids=tuple(d.get("rcids", ())),
+            type=TypeCase(RequestType(t["t"]), read_nonlinearizable=t["rnl"],
+                          read_after_write_consistent=t.get("raw", False),
+                          stale_read_min_index=t["smi"], watch_index=t["wi"],
+                          watch_replication=ReplicationLevel(t["wr"]),
+                          stream_id=t["si"], message_id=t["mi"],
+                          end_of_request=t["eor"]))
+
+    def to_bytes(self) -> bytes:
+        return msgpack.packb(self.to_dict(), use_bin_type=True)
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "RaftClientRequest":
+        return RaftClientRequest.from_dict(msgpack.unpackb(b, raw=False))
+
+    def __str__(self) -> str:
+        return (f"{self.client_id}->{self.server_id}@{self.group_id}"
+                f"#{self.call_id}:{self.type.type.name}")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommitInfo:
+    """peer -> commitIndex, piggybacked on replies (CommitInfoProto:175)."""
+
+    server: RaftPeerId
+    commit_index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftClientReply:
+    client_id: ClientId
+    server_id: RaftPeerId
+    group_id: RaftGroupId
+    call_id: int
+    success: bool
+    message: Message = Message.EMPTY
+    exception: Optional[RaftException] = None
+    log_index: int = -1
+    commit_infos: tuple[CommitInfo, ...] = ()
+
+    def get_not_leader_exception(self):
+        from ratis_tpu.protocol.exceptions import NotLeaderException
+        return self.exception if isinstance(self.exception, NotLeaderException) else None
+
+    def to_dict(self) -> dict:
+        return {
+            "cid": self.client_id.to_bytes(), "sid": self.server_id.id,
+            "gid": self.group_id.to_bytes(), "call": self.call_id,
+            "ok": self.success, "msg": self.message.content,
+            "li": self.log_index,
+            "exc": None if self.exception is None else exception_to_wire(self.exception),
+            "ci": [[c.server.id, c.commit_index] for c in self.commit_infos],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "RaftClientReply":
+        return RaftClientReply(
+            client_id=ClientId.value_of(d["cid"]),
+            server_id=RaftPeerId.value_of(d["sid"]),
+            group_id=RaftGroupId.value_of(d["gid"]),
+            call_id=d["call"], success=d["ok"], message=Message(d["msg"]),
+            log_index=d.get("li", -1),
+            exception=None if d.get("exc") is None else exception_from_wire(d["exc"]),
+            commit_infos=tuple(CommitInfo(RaftPeerId.value_of(s), i)
+                               for s, i in d.get("ci", ())))
+
+    def to_bytes(self) -> bytes:
+        return msgpack.packb(self.to_dict(), use_bin_type=True)
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "RaftClientReply":
+        return RaftClientReply.from_dict(msgpack.unpackb(b, raw=False))
+
+    @staticmethod
+    def success_reply(request: RaftClientRequest, message: Message = Message.EMPTY,
+                      log_index: int = -1, commit_infos=()) -> "RaftClientReply":
+        return RaftClientReply(request.client_id, request.server_id,
+                               request.group_id, request.call_id, True,
+                               message=message, log_index=log_index,
+                               commit_infos=tuple(commit_infos))
+
+    @staticmethod
+    def failure_reply(request: RaftClientRequest, exception: RaftException,
+                      commit_infos=()) -> "RaftClientReply":
+        return RaftClientReply(request.client_id, request.server_id,
+                               request.group_id, request.call_id, False,
+                               exception=exception, commit_infos=tuple(commit_infos))
+
+    def __str__(self) -> str:
+        status = "OK" if self.success else f"FAIL({type(self.exception).__name__})"
+        return f"reply#{self.call_id}:{status}@i{self.log_index}"

@@ -1,0 +1,31 @@
+"""TermIndex: (term, index) pair ordering log positions.
+
+Capability parity with the reference's TermIndex
+(ratis-server-api/src/main/java/org/apache/ratis/server/protocol/TermIndex.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar
+
+INVALID_LOG_INDEX = -1  # reference RaftLog.INVALID_LOG_INDEX (RaftLog.java:44)
+INVALID_TERM = -1
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class TermIndex:
+    term: int
+    index: int
+
+    INITIAL_VALUE: ClassVar["TermIndex"]
+
+    @staticmethod
+    def value_of(term: int, index: int) -> "TermIndex":
+        return TermIndex(term, index)
+
+    def __str__(self) -> str:
+        return f"(t:{self.term}, i:{self.index})"
+
+
+TermIndex.INITIAL_VALUE = TermIndex(INVALID_TERM, INVALID_LOG_INDEX)

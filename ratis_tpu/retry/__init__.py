@@ -1,0 +1,4 @@
+from ratis_tpu.retry.policies import (ClientRetryEvent, ExceptionDependentRetry,
+                                      ExponentialBackoffRetry, MultipleLinearRandomRetry,
+                                      RequestTypeDependentRetryPolicy, RetryAction,
+                                      RetryLimited, RetryPolicies, RetryPolicy)

@@ -1,0 +1,190 @@
+"""Client retry policies.
+
+Capability parity with the reference's retry package
+(ratis-common/src/main/java/org/apache/ratis/retry/RetryPolicies.java,
+ExponentialBackoffRetry.java, MultipleLinearRandomRetry.java,
+ExceptionDependentRetry.java) and the client-side
+RequestTypeDependentRetryPolicy (ratis-client/.../retry/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Optional
+
+from ratis_tpu.util.timeduration import TimeDuration
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientRetryEvent:
+    """What happened on one failed attempt, fed to the policy."""
+
+    attempt_count: int
+    cause: Optional[BaseException] = None
+    request: object = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryAction:
+    should_retry: bool
+    sleep_time: TimeDuration = TimeDuration.ZERO
+
+
+class RetryPolicy:
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        raise NotImplementedError
+
+    def __str__(self) -> str:
+        return type(self).__name__
+
+
+class _NoRetry(RetryPolicy):
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        return RetryAction(False)
+
+
+class _RetryForeverNoSleep(RetryPolicy):
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        return RetryAction(True)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryForeverWithSleep(RetryPolicy):
+    sleep_time: TimeDuration
+
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        return RetryAction(True, self.sleep_time)
+
+    def __str__(self) -> str:
+        return f"RetryForeverWithSleep({self.sleep_time})"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryLimited(RetryPolicy):
+    """retryUpToMaximumCountWithFixedSleep (RetryPolicies.java)."""
+
+    max_attempts: int
+    sleep_time: TimeDuration
+
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        if event.attempt_count >= self.max_attempts:
+            return RetryAction(False)
+        return RetryAction(True, self.sleep_time)
+
+    def __str__(self) -> str:
+        return f"RetryLimited(maxAttempts={self.max_attempts}, sleepTime={self.sleep_time})"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExponentialBackoffRetry(RetryPolicy):
+    """Randomized exponential backoff (reference ExponentialBackoffRetry.java):
+    sleep ~ U(0.5, 1.5) * base * 2^attempt, capped at max_sleep."""
+
+    base_sleep: TimeDuration
+    max_sleep: Optional[TimeDuration] = None
+    max_attempts: int = 0x7FFFFFFF
+
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        if event.attempt_count >= self.max_attempts:
+            return RetryAction(False)
+        exp = min(event.attempt_count, 30)
+        sleep = self.base_sleep.seconds * (2 ** exp) * (0.5 + random.random())
+        if self.max_sleep is not None:
+            sleep = min(sleep, self.max_sleep.seconds)
+        return RetryAction(True, TimeDuration(sleep))
+
+
+@dataclasses.dataclass(frozen=True)
+class MultipleLinearRandomRetry(RetryPolicy):
+    """N1 attempts ~sleep T1, then N2 attempts ~sleep T2, ... with +/-50%
+    randomization (reference MultipleLinearRandomRetry.java).  Built from a
+    string like '1ms,10, 2ms,20'."""
+
+    pairs: tuple[tuple[int, TimeDuration], ...]  # (count, sleep)
+
+    @staticmethod
+    def parse_comma_separated(s: str) -> "MultipleLinearRandomRetry":
+        parts = [x.strip() for x in s.split(",") if x.strip()]
+        if len(parts) % 2 != 0 or not parts:
+            raise ValueError(f"even number of elements required: {s!r}")
+        pairs = []
+        for i in range(0, len(parts), 2):
+            sleep = TimeDuration.valueOf(parts[i])
+            count = int(parts[i + 1])
+            pairs.append((count, sleep))
+        return MultipleLinearRandomRetry(tuple(pairs))
+
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        n = event.attempt_count
+        for count, sleep in self.pairs:
+            if n < count:
+                ms = sleep.to_ms() * (0.5 + random.random())
+                return RetryAction(True, TimeDuration.millis(ms))
+            n -= count
+        return RetryAction(False)
+
+
+class ExceptionDependentRetry(RetryPolicy):
+    """Dispatch to a policy by exception type (ExceptionDependentRetry.java)."""
+
+    def __init__(self, default_policy: RetryPolicy,
+                 exception_policies: dict[type, RetryPolicy],
+                 max_attempts: Optional[int] = None):
+        self._default = default_policy
+        self._map = dict(exception_policies)
+        self._max_attempts = max_attempts
+
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        if self._max_attempts is not None and event.attempt_count >= self._max_attempts:
+            return RetryAction(False)
+        policy = self._default
+        if event.cause is not None:
+            for cls in type(event.cause).__mro__:
+                if cls in self._map:
+                    policy = self._map[cls]
+                    break
+        return policy.handle_attempt_failure(event)
+
+
+class RequestTypeDependentRetryPolicy(RetryPolicy):
+    """Dispatch to a policy (and optional timeout) by client request type
+    (reference ratis-client/.../retry/RequestTypeDependentRetryPolicy.java)."""
+
+    def __init__(self, default_policy: RetryPolicy,
+                 type_policies: Optional[dict] = None,
+                 type_timeouts: Optional[dict] = None):
+        self._default = default_policy
+        self._policies = dict(type_policies or {})
+        self._timeouts = dict(type_timeouts or {})
+
+    def timeout_for(self, request_type):
+        return self._timeouts.get(request_type)
+
+    def handle_attempt_failure(self, event: ClientRetryEvent) -> RetryAction:
+        policy = self._default
+        req = event.request
+        if req is not None:
+            policy = self._policies.get(req.type.type, self._default)
+        return policy.handle_attempt_failure(event)
+
+
+class RetryPolicies:
+    RETRY_FOREVER_NO_SLEEP = _RetryForeverNoSleep()
+    NO_RETRY = _NoRetry()
+
+    @staticmethod
+    def retry_forever_no_sleep() -> RetryPolicy:
+        return RetryPolicies.RETRY_FOREVER_NO_SLEEP
+
+    @staticmethod
+    def no_retry() -> RetryPolicy:
+        return RetryPolicies.NO_RETRY
+
+    @staticmethod
+    def retry_forever_with_sleep(sleep) -> RetryPolicy:
+        return RetryForeverWithSleep(TimeDuration.valueOf(sleep))
+
+    @staticmethod
+    def retry_up_to_maximum_count_with_fixed_sleep(max_attempts: int, sleep) -> RetryPolicy:
+        return RetryLimited(max_attempts, TimeDuration.valueOf(sleep))

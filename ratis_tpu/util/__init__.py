@@ -1,0 +1,2 @@
+from ratis_tpu.util.timeduration import TimeDuration
+from ratis_tpu.util.lifecycle import LifeCycle, LifeCycleState

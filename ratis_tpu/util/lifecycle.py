@@ -1,0 +1,130 @@
+"""Component lifecycle state machine.
+
+Capability parity with the reference's LifeCycle
+(ratis-common/src/main/java/org/apache/ratis/util/LifeCycle.java): a named
+state machine with a fixed legal-transition graph, used by servers, logs and
+transports to guard start/close ordering.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable, Iterable
+
+
+class LifeCycleState(enum.Enum):
+    NEW = "NEW"
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    PAUSING = "PAUSING"
+    PAUSED = "PAUSED"
+    EXCEPTION = "EXCEPTION"
+    CLOSING = "CLOSING"
+    CLOSED = "CLOSED"
+
+    def is_closing_or_closed(self) -> bool:
+        return self in (LifeCycleState.CLOSING, LifeCycleState.CLOSED)
+
+    def is_running(self) -> bool:
+        return self is LifeCycleState.RUNNING
+
+    def is_paused(self) -> bool:
+        return self in (LifeCycleState.PAUSING, LifeCycleState.PAUSED)
+
+
+S = LifeCycleState
+
+# Legal predecessor sets (mirrors the reference's transition graph,
+# LifeCycle.java "State.isValid").
+_PREDECESSORS: dict[LifeCycleState, frozenset[LifeCycleState]] = {
+    S.NEW: frozenset({S.STARTING}),
+    S.STARTING: frozenset({S.NEW, S.PAUSED}),
+    S.RUNNING: frozenset({S.STARTING}),
+    S.PAUSING: frozenset({S.RUNNING}),
+    S.PAUSED: frozenset({S.PAUSING}),
+    S.EXCEPTION: frozenset({S.STARTING, S.PAUSING, S.RUNNING}),
+    S.CLOSING: frozenset({S.STARTING, S.RUNNING, S.PAUSING, S.PAUSED, S.EXCEPTION}),
+    S.CLOSED: frozenset({S.NEW, S.CLOSING}),
+}
+
+
+class LifeCycle:
+    def __init__(self, name: str):
+        self._name = name
+        self._state = S.NEW
+        self._lock = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def get_current_state(self) -> LifeCycleState:
+        return self._state
+
+    def transition(self, to: LifeCycleState) -> None:
+        with self._lock:
+            if self._state not in _PREDECESSORS[to]:
+                raise IllegalLifeCycleTransition(
+                    f"{self._name}: illegal transition {self._state.value} -> {to.value}"
+                )
+            self._state = to
+
+    def transition_if_not_equal(self, to: LifeCycleState) -> bool:
+        with self._lock:
+            if self._state is to:
+                return False
+            if self._state not in _PREDECESSORS[to]:
+                raise IllegalLifeCycleTransition(
+                    f"{self._name}: illegal transition {self._state.value} -> {to.value}"
+                )
+            self._state = to
+            return True
+
+    def compare_and_transition(self, expected: LifeCycleState, to: LifeCycleState) -> bool:
+        with self._lock:
+            if self._state is not expected:
+                return False
+            self._state = to
+            return True
+
+    def assert_current_state(self, expected: Iterable[LifeCycleState] | LifeCycleState) -> None:
+        states = (expected,) if isinstance(expected, LifeCycleState) else tuple(expected)
+        if self._state not in states:
+            raise IllegalLifeCycleTransition(
+                f"{self._name}: state is {self._state.value}, expected one of "
+                f"{[s.value for s in states]}"
+            )
+
+    def start_and_transition(self, start: Callable[[], None]) -> None:
+        """Run ``start`` bracketed by STARTING -> RUNNING, EXCEPTION on error."""
+        self.transition(S.STARTING)
+        try:
+            start()
+            self.transition(S.RUNNING)
+        except Exception:
+            self.transition(S.EXCEPTION)
+            raise
+
+    def check_state_and_close(self, close: Callable[[], None]) -> bool:
+        with self._lock:
+            if self._state.is_closing_or_closed():
+                return False
+            # NEW -> CLOSED directly (nothing started); otherwise via CLOSING,
+            # matching the reference graph (LifeCycle.java:97-104).
+            self._state = S.CLOSED if self._state is S.NEW else S.CLOSING
+            if self._state is S.CLOSED:
+                return True
+        try:
+            close()
+        finally:
+            with self._lock:
+                self._state = S.CLOSED
+        return True
+
+    def __str__(self) -> str:
+        return f"{self._name}:{self._state.value}"
+
+
+class IllegalLifeCycleTransition(RuntimeError):
+    pass

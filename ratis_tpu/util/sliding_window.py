@@ -1,0 +1,101 @@
+"""Sliding windows for ordered async streaming.
+
+Capability parity with the reference's SlidingWindow
+(ratis-common/src/main/java/org/apache/ratis/util/SlidingWindow.java:39):
+
+- ``SlidingWindowClient``: assigns consecutive seqNums to submitted requests,
+  tracks replies, supports first-request flagging after leader failover and
+  bulk retry from a given seqNum (SlidingWindow.java:277,349,325).
+- ``SlidingWindowServer``: delays out-of-order requests until all lower
+  seqNums have been processed, so the server applies an ordered stream even
+  over an unordered transport.
+
+asyncio-native: no locks; all methods must be called from the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Generic, Optional, TypeVar
+
+REQ = TypeVar("REQ")
+REP = TypeVar("REP")
+
+
+class SlidingWindowClient(Generic[REQ]):
+    def __init__(self, name: str = ""):
+        self._name = name
+        self._next_seq = 0
+        self._first_seq = -1  # seqNum of the current "first" (post-failover) request
+        self._requests: dict[int, REQ] = {}
+
+    def next_seq_num(self) -> int:
+        seq = self._next_seq
+        self._next_seq += 1
+        return seq
+
+    def submit_new_request(self, make_request: Callable[[int], REQ]) -> REQ:
+        seq = self.next_seq_num()
+        request = make_request(seq)
+        self._requests[seq] = request
+        if self._first_seq < 0:
+            self._first_seq = seq
+        return request
+
+    def is_first(self, seq: int) -> bool:
+        return seq == self._first_seq
+
+    def receive_reply(self, seq: int) -> None:
+        self._requests.pop(seq, None)
+        if seq == self._first_seq:
+            self._first_seq = min(self._requests) if self._requests else -1
+
+    def pending_requests(self) -> list[REQ]:
+        return [self._requests[k] for k in sorted(self._requests)]
+
+    def reset_first_seq(self) -> None:
+        """After failover, the lowest outstanding request becomes 'first' again
+        so the new server resets its processing window."""
+        self._first_seq = min(self._requests) if self._requests else -1
+
+    def size(self) -> int:
+        return len(self._requests)
+
+
+class SlidingWindowServer(Generic[REQ]):
+    """Processes requests strictly in seqNum order.
+
+    ``receive(seq, is_first, request)`` either dispatches immediately (when
+    seq == nextToProcess) plus any queued successors, or parks the request.
+    """
+
+    def __init__(self, process: Callable[[REQ], Awaitable[None]], name: str = ""):
+        self._process = process
+        self._name = name
+        self._next_to_process: Optional[int] = None
+        self._pending: dict[int, REQ] = {}
+        self._drain_lock = asyncio.Lock()
+
+    async def receive(self, seq: int, is_first: bool, request: REQ) -> None:
+        if is_first or self._next_to_process is None:
+            self._next_to_process = seq
+            # A post-failover "first" request resets the window; anything
+            # parked below it can never be processed — drop it.
+            for stale in [s for s in self._pending if s < seq]:
+                del self._pending[stale]
+        if seq < self._next_to_process:
+            return  # duplicate of an already-processed request
+        self._pending[seq] = request
+        # Serialize processing: without the lock, a receive() arriving while a
+        # predecessor's process() is awaited would dispatch out of order.
+        async with self._drain_lock:
+            while self._next_to_process in self._pending:
+                req = self._pending.pop(self._next_to_process)
+                # Increment before the await so a duplicate arriving while
+                # process() runs fails the `seq < next` check and is dropped;
+                # ordering is still guaranteed by the lock held across the await.
+                self._next_to_process += 1
+                await self._process(req)
+
+    def pending_count(self) -> int:
+        return len(self._pending)

@@ -1,0 +1,91 @@
+"""Time duration value type with unit parsing.
+
+Capability parity with the reference's TimeDuration
+(ratis-common/src/main/java/org/apache/ratis/util/TimeDuration.java): a
+comparable, arithmetic-friendly duration parsed from strings like "150ms",
+"3s", "1min".  Internally a float number of seconds (Python-idiomatic rather
+than (long, TimeUnit) pairs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import ClassVar
+
+_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "μs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "sec": 1.0,
+    "m": 60.0,
+    "min": 60.0,
+    "h": 3600.0,
+    "hr": 3600.0,
+    "d": 86400.0,
+    "day": 86400.0,
+}
+
+_PATTERN = re.compile(r"^\s*(-?\d+(?:\.\d+)?)\s*([a-zμ]*)\s*$")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class TimeDuration:
+    """An immutable duration; ``seconds`` is the single canonical field."""
+
+    seconds: float
+
+    ZERO: ClassVar["TimeDuration"]
+    ONE_SECOND: ClassVar["TimeDuration"]
+
+    @staticmethod
+    def valueOf(value: "TimeDuration | str | int | float") -> "TimeDuration":
+        if isinstance(value, TimeDuration):
+            return value
+        if isinstance(value, (int, float)):
+            return TimeDuration(float(value))
+        m = _PATTERN.match(value.lower())
+        if not m:
+            raise ValueError(f"cannot parse time duration {value!r}")
+        num, unit = m.groups()
+        if unit and unit not in _UNITS:
+            raise ValueError(f"unknown time unit {unit!r} in {value!r}")
+        return TimeDuration(float(num) * (_UNITS[unit] if unit else 1.0))
+
+    @staticmethod
+    def millis(ms: float) -> "TimeDuration":
+        return TimeDuration(ms / 1e3)
+
+    def to_ms(self) -> float:
+        return self.seconds * 1e3
+
+    def is_positive(self) -> bool:
+        return self.seconds > 0
+
+    def is_non_negative(self) -> bool:
+        return self.seconds >= 0
+
+    def multiply(self, factor: float) -> "TimeDuration":
+        return TimeDuration(self.seconds * factor)
+
+    def add(self, other: "TimeDuration | float") -> "TimeDuration":
+        return TimeDuration(self.seconds + TimeDuration.valueOf(other).seconds)
+
+    def subtract(self, other: "TimeDuration | float") -> "TimeDuration":
+        return TimeDuration(self.seconds - TimeDuration.valueOf(other).seconds)
+
+    def __str__(self) -> str:
+        s = self.seconds
+        if s == 0:
+            return "0s"
+        if abs(s) >= 1:
+            return f"{s:g}s"
+        if abs(s) >= 1e-3:
+            return f"{s * 1e3:g}ms"
+        return f"{s * 1e6:g}us"
+
+
+TimeDuration.ZERO = TimeDuration(0.0)
+TimeDuration.ONE_SECOND = TimeDuration(1.0)

@@ -1,0 +1,18 @@
+"""Test environment: force an 8-virtual-device CPU platform BEFORE jax import,
+so multi-chip sharding paths are exercised without TPU hardware."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clear_injections():
+    yield
+    from ratis_tpu.util import injection
+    injection.clear()

@@ -1,0 +1,338 @@
+"""Substrate unit tests: config, ids, value types, retry, lifecycle, codecs."""
+
+import pytest
+
+from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys, parse_size
+from ratis_tpu.protocol import (ClientId, Message, RaftGroup, RaftGroupId,
+                                RaftPeer, RaftPeerId, RaftPeerRole, TermIndex)
+from ratis_tpu.protocol.exceptions import (NotLeaderException,
+                                           NotReplicatedException,
+                                           exception_from_wire,
+                                           exception_to_wire)
+from ratis_tpu.protocol.logentry import (LogEntry, LogEntryKind,
+                                         make_config_entry,
+                                         make_metadata_entry,
+                                         make_transaction_entry)
+from ratis_tpu.protocol.raftrpc import (AppendEntriesReply,
+                                        AppendEntriesRequest, AppendResult,
+                                        RaftRpcHeader, RequestVoteRequest,
+                                        decode_rpc, encode_rpc)
+from ratis_tpu.protocol.requests import (RaftClientReply, RaftClientRequest,
+                                         ReplicationLevel,
+                                         watch_request_type)
+from ratis_tpu.retry import (ClientRetryEvent, ExponentialBackoffRetry,
+                             MultipleLinearRandomRetry, RetryPolicies)
+from ratis_tpu.util import LifeCycle, LifeCycleState, TimeDuration
+from ratis_tpu.util.lifecycle import IllegalLifeCycleTransition
+
+
+class TestTimeDuration:
+    def test_parse_units(self):
+        assert TimeDuration.valueOf("150ms").seconds == pytest.approx(0.15)
+        assert TimeDuration.valueOf("3s").seconds == 3
+        assert TimeDuration.valueOf("2min").seconds == 120
+        assert TimeDuration.valueOf("1h").seconds == 3600
+        assert TimeDuration.valueOf(0.5).seconds == 0.5
+
+    def test_ordering_arithmetic(self):
+        a, b = TimeDuration.valueOf("100ms"), TimeDuration.valueOf("1s")
+        assert a < b
+        assert b.multiply(2).seconds == 2
+        assert b.subtract(a).seconds == pytest.approx(0.9)
+
+    def test_bad_parse(self):
+        with pytest.raises(ValueError):
+            TimeDuration.valueOf("abc")
+
+
+class TestRaftProperties:
+    def test_typed_getters(self):
+        p = RaftProperties()
+        p.set_int("a.b", 42)
+        p.set_boolean("flag", True)
+        p.set("dur", "250ms")
+        p.set("size", "4MB")
+        assert p.get_int("a.b", 0) == 42
+        assert p.get_boolean("flag", False)
+        assert p.get_time_duration("dur", "1s").to_ms() == 250
+        assert p.get_size("size", 0) == 4 << 20
+        assert p.get_int("missing", 7) == 7
+
+    def test_variable_substitution(self):
+        p = RaftProperties()
+        p.set("base", "/data")
+        p.set("raft.server.storage.dir", "${base}/ratis")
+        assert p.get("raft.server.storage.dir") == "/data/ratis"
+
+    def test_size_parse(self):
+        assert parse_size("64KB") == 64 << 10
+        assert parse_size("1gb") == 1 << 30
+        assert parse_size(123) == 123
+
+    def test_config_keys(self):
+        p = RaftProperties()
+        assert RaftServerConfigKeys.Rpc.timeout_min(p).to_ms() == 150
+        RaftServerConfigKeys.Rpc.set_timeout(p, "10ms", "20ms")
+        assert RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() == 20
+        assert RaftServerConfigKeys.Log.segment_size_max(p) == 8 << 20
+
+
+class TestIds:
+    def test_uuid_roundtrip(self):
+        g = RaftGroupId.random_id()
+        assert RaftGroupId.value_of(g.to_bytes()) == g
+        assert not g.is_empty()
+        assert RaftGroupId.empty_id().is_empty()
+
+    def test_peer_id(self):
+        p = RaftPeerId.value_of("s0")
+        assert p == RaftPeerId.value_of(b"s0")
+        assert str(p) == "s0"
+
+    def test_group(self):
+        peers = tuple(RaftPeer(RaftPeerId.value_of(f"s{i}")) for i in range(3))
+        g = RaftGroup.value_of(RaftGroupId.random_id(), peers)
+        assert g.get_peer(RaftPeerId.value_of("s1")) == peers[1]
+        assert g.get_peer(RaftPeerId.value_of("nope")) is None
+        assert RaftGroup.from_dict(g.to_dict()) == g
+
+    def test_peer_roundtrip(self):
+        p = RaftPeer(RaftPeerId.value_of("x"), address="h:1", priority=2,
+                     startup_role=RaftPeerRole.LISTENER)
+        assert RaftPeer.from_dict(p.to_dict()) == p
+        assert p.is_listener()
+
+
+class TestLogEntryCodec:
+    def test_transaction_roundtrip(self):
+        e = make_transaction_entry(3, 17, ClientId.random_id(), 5, b"payload",
+                                   sm_data=b"smdata")
+        e2 = LogEntry.from_bytes(e.to_bytes())
+        assert e2 == e
+        assert e2.term_index() == TermIndex(3, 17)
+
+    def test_sm_data_excluded_from_storage_bytes(self):
+        e = make_transaction_entry(1, 1, ClientId.random_id(), 1, b"d", b"big" * 100)
+        stored = LogEntry.from_bytes(e.to_bytes(include_sm_data=False))
+        assert stored.smlog.sm_data is None
+        assert stored.smlog.log_data == b"d"
+
+    def test_config_roundtrip(self):
+        peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"), priority=i) for i in range(3)]
+        e = make_config_entry(2, 9, peers, old_peers=peers[:2])
+        e2 = LogEntry.from_bytes(e.to_bytes())
+        assert e2.conf.peers == tuple(peers)
+        assert e2.conf.old_peers == tuple(peers[:2])
+        assert e2.is_config()
+
+    def test_metadata(self):
+        e = make_metadata_entry(1, 4, 99)
+        assert LogEntry.from_bytes(e.to_bytes()).commit_index == 99
+
+
+class TestRpcCodec:
+    def _header(self):
+        return RaftRpcHeader(RaftPeerId.value_of("a"), RaftPeerId.value_of("b"),
+                             RaftGroupId.random_id(), 7)
+
+    def test_vote_roundtrip(self):
+        r = RequestVoteRequest(self._header(), 5, TermIndex(4, 10), pre_vote=True)
+        r2 = decode_rpc(encode_rpc(r))
+        assert r2 == r
+
+    def test_append_roundtrip(self):
+        entries = tuple(make_transaction_entry(2, i, ClientId.random_id(), i, b"x")
+                        for i in range(3))
+        r = AppendEntriesRequest(self._header(), 2, TermIndex(1, 4), entries, 3)
+        r2 = decode_rpc(encode_rpc(r))
+        assert r2.entries == entries
+        assert r2.previous == TermIndex(1, 4)
+
+    def test_append_reply_roundtrip(self):
+        rep = AppendEntriesReply(self._header(), 2, AppendResult.INCONSISTENCY,
+                                 5, 3, 4, is_heartbeat=True)
+        assert decode_rpc(encode_rpc(rep)) == rep
+
+
+class TestClientRequestCodec:
+    def test_write_roundtrip(self):
+        req = RaftClientRequest(ClientId.random_id(), RaftPeerId.value_of("s0"),
+                                RaftGroupId.random_id(), 11,
+                                Message.value_of("hello"))
+        req2 = RaftClientRequest.from_bytes(req.to_bytes())
+        assert req2 == req
+        assert req2.is_write()
+
+    def test_watch_roundtrip(self):
+        req = RaftClientRequest(
+            ClientId.random_id(), RaftPeerId.value_of("s0"),
+            RaftGroupId.random_id(), 12,
+            type=watch_request_type(100, ReplicationLevel.ALL_COMMITTED))
+        req2 = RaftClientRequest.from_bytes(req.to_bytes())
+        assert req2.type.watch_index == 100
+        assert req2.type.watch_replication == ReplicationLevel.ALL_COMMITTED
+
+    def test_reply_with_exception(self):
+        req = RaftClientRequest(ClientId.random_id(), RaftPeerId.value_of("s0"),
+                                RaftGroupId.random_id(), 1)
+        leader = RaftPeer(RaftPeerId.value_of("s2"), "h:2")
+        reply = RaftClientReply.failure_reply(
+            req, NotLeaderException(suggested_leader=leader, peers=(leader,)))
+        reply2 = RaftClientReply.from_bytes(reply.to_bytes())
+        assert not reply2.success
+        nle = reply2.get_not_leader_exception()
+        assert nle is not None and nle.suggested_leader == leader
+
+
+class TestExceptionWire:
+    def test_not_replicated(self):
+        e = NotReplicatedException(3, ReplicationLevel.MAJORITY_COMMITTED, 55)
+        e2 = exception_from_wire(exception_to_wire(e))
+        assert isinstance(e2, NotReplicatedException)
+        assert e2.log_index == 55
+        assert e2.replication == ReplicationLevel.MAJORITY_COMMITTED
+
+    def test_unknown_type_degrades_to_base(self):
+        from ratis_tpu.protocol.exceptions import RaftException
+        e2 = exception_from_wire({"type": "Bogus", "msg": "m"})
+        assert type(e2) is RaftException
+
+
+class TestRetryPolicies:
+    def test_limited(self):
+        p = RetryPolicies.retry_up_to_maximum_count_with_fixed_sleep(3, "10ms")
+        assert p.handle_attempt_failure(ClientRetryEvent(2)).should_retry
+        assert not p.handle_attempt_failure(ClientRetryEvent(3)).should_retry
+
+    def test_exponential_backoff_capped(self):
+        p = ExponentialBackoffRetry(TimeDuration.millis(1), TimeDuration.millis(8))
+        a = p.handle_attempt_failure(ClientRetryEvent(20))
+        assert a.should_retry and a.sleep_time.to_ms() <= 8
+
+    def test_multiple_linear(self):
+        p = MultipleLinearRandomRetry.parse_comma_separated("1ms,2, 5ms,1")
+        assert p.handle_attempt_failure(ClientRetryEvent(0)).should_retry
+        assert p.handle_attempt_failure(ClientRetryEvent(2)).should_retry
+        assert not p.handle_attempt_failure(ClientRetryEvent(3)).should_retry
+
+
+class TestLifeCycle:
+    def test_normal_path(self):
+        lc = LifeCycle("x")
+        lc.transition(LifeCycleState.STARTING)
+        lc.transition(LifeCycleState.RUNNING)
+        assert lc.get_current_state().is_running()
+        assert lc.check_state_and_close(lambda: None)
+        assert lc.get_current_state() == LifeCycleState.CLOSED
+        assert not lc.check_state_and_close(lambda: None)
+
+    def test_illegal_transition(self):
+        lc = LifeCycle("x")
+        with pytest.raises(IllegalLifeCycleTransition):
+            lc.transition(LifeCycleState.RUNNING)
+
+    def test_start_failure_goes_to_exception(self):
+        lc = LifeCycle("x")
+        with pytest.raises(RuntimeError, match="boom"):
+            lc.start_and_transition(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        assert lc.get_current_state() == LifeCycleState.EXCEPTION
+
+
+class TestSlidingWindow:
+    def test_server_strict_ordering_under_concurrency(self):
+        import asyncio
+        from ratis_tpu.util.sliding_window import SlidingWindowServer
+
+        async def main():
+            done = []
+
+            async def process(r):
+                if r == 0:
+                    await asyncio.sleep(0.02)
+                done.append(r)
+
+            w = SlidingWindowServer(process)
+            t0 = asyncio.create_task(w.receive(0, True, 0))
+            await asyncio.sleep(0.005)
+            t1 = asyncio.create_task(w.receive(1, False, 1))
+            await asyncio.gather(t0, t1)
+            return done
+
+        assert asyncio.run(main()) == [0, 1]
+
+    def test_server_failover_drops_stale_pending(self):
+        import asyncio
+        from ratis_tpu.util.sliding_window import SlidingWindowServer
+
+        async def main():
+            done = []
+
+            async def process(r):
+                done.append(r)
+
+            w = SlidingWindowServer(process)
+            await w.receive(2, True, 2)
+            await w.receive(5, False, 5)  # parked, waiting for 3..4
+            await w.receive(7, True, 7)   # failover: new first
+            return done, w.pending_count()
+
+        done, pending = asyncio.run(main())
+        assert done == [2, 7] and pending == 0
+
+    def test_client_window(self):
+        from ratis_tpu.util.sliding_window import SlidingWindowClient
+        c = SlidingWindowClient()
+        reqs = [c.submit_new_request(lambda seq: seq) for _ in range(3)]
+        assert reqs == [0, 1, 2] and c.is_first(0)
+        c.receive_reply(0)
+        assert c.is_first(1) and c.size() == 2
+        c.receive_reply(2)
+        assert c.pending_requests() == [1]
+
+
+class TestExceptionWireDefaults:
+    def test_attr_bearing_exceptions_roundtrip_clean(self):
+        from ratis_tpu.protocol.exceptions import (ChecksumException,
+                                                   LeaderNotReadyException,
+                                                   RaftRetryFailureException)
+        e = exception_from_wire(exception_to_wire(LeaderNotReadyException("m1@g")))
+        assert str(e) == "m1@g is in LEADER state but not ready yet"
+        assert e.member_id is None
+        e2 = exception_from_wire(exception_to_wire(
+            RaftRetryFailureException(None, 5, "P")))
+        assert str(e2) == "Failed None for 5 attempts with P"
+        e3 = exception_from_wire(exception_to_wire(ChecksumException("bad", 9)))
+        assert isinstance(e3, ChecksumException) and e3.position == -1
+
+
+class TestLifeCycleReferenceGraph:
+    def test_new_closes_directly(self):
+        lc = LifeCycle("x")
+        assert lc.check_state_and_close(lambda: None)
+        assert lc.get_current_state() == LifeCycleState.CLOSED
+
+    def test_starting_back_to_new_allowed(self):
+        lc = LifeCycle("x")
+        lc.transition(LifeCycleState.STARTING)
+        lc.transition(LifeCycleState.NEW)  # reference-legal start-failure retry
+        assert lc.get_current_state() == LifeCycleState.NEW
+
+    def test_starting_to_paused_rejected(self):
+        lc = LifeCycle("x")
+        lc.transition(LifeCycleState.STARTING)
+        with pytest.raises(IllegalLifeCycleTransition):
+            lc.transition(LifeCycleState.PAUSED)
+
+
+def test_parse_size_unknown_unit_is_value_error():
+    with pytest.raises(ValueError, match="unknown size unit"):
+        parse_size("64KiB")
+
+
+def test_dataclass_constants_are_classvars():
+    import dataclasses
+    from ratis_tpu.util.timeduration import TimeDuration as TD
+    assert [f.name for f in dataclasses.fields(TermIndex)] == ["term", "index"]
+    assert [f.name for f in dataclasses.fields(Message)] == ["content"]
+    assert [f.name for f in dataclasses.fields(TD)] == ["seconds"]
+    assert TermIndex(1, 2) > TermIndex.INITIAL_VALUE
