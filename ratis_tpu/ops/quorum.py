@@ -1,0 +1,248 @@
+"""Batched quorum kernels: the consensus math of every group in one dispatch.
+
+This module is the point of the framework.  The reference runs, per RaftGroup,
+a Java event loop that (a) advances the leader commit index by sorting
+follower matchIndexes (LeaderStateImpl.updateCommit/getMajorityMin,
+ratis-server/.../impl/LeaderStateImpl.java:907,917 and
+MinMajorityMax.getMajority:898), (b) tallies election votes with priority
+vetoes (LeaderElection.waitForResults, .../impl/LeaderElection.java:498-592),
+(c) detects election timeouts (FollowerState.java:64) and leader-lease /
+leadership staleness (LeaderLease.java:90, LeaderStateImpl.checkLeadership:1096).
+Here all four are pure, shape-stable jnp functions over ``[G, P]`` arrays
+(G = group slots, P = peer slots) that XLA compiles into a single program —
+one dispatch advances every group a host serves.
+
+Conventions:
+- Peer sets are boolean masks over the fixed P axis.  Joint consensus
+  (reference RaftConfigurationImpl.hasMajority:265-281) is two masks:
+  ``conf_cur`` and ``conf_old`` (all-False when not in joint mode).
+  Listeners are simply never in a mask.
+- Indices are integer arrays (int32 by default, dtype-polymorphic).
+- Times are integer milliseconds since engine start (exact, TPU-friendly).
+- All functions are total: group slots that are unused/not-leader must be
+  masked by the caller (the engine passes role masks).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+def conf_size(mask: jax.Array) -> jax.Array:
+    """[G, P] bool -> [G] number of voting members."""
+    return jnp.sum(mask, axis=-1)
+
+
+def majority_count(mask: jax.Array) -> jax.Array:
+    """[G, P] bool -> [G] votes needed for majority: floor(size/2) + 1."""
+    return conf_size(mask) // 2 + 1
+
+
+def majority_min(values: jax.Array, mask: jax.Array) -> jax.Array:
+    """Per group, the greatest v such that a majority of members have
+    value >= v — i.e. ascending-sorted member values at position (k-1)//2
+    (exactly MinMajorityMax.getMajority, LeaderStateImpl.java:898).
+
+    values: [G, P] int; mask: [G, P] bool.  Groups with an empty mask get
+    dtype-min (never advances anything).
+    """
+    big = jnp.array(jnp.iinfo(values.dtype).max, values.dtype)
+    masked = jnp.where(mask, values, big)  # non-members sort to the top
+    sorted_asc = jnp.sort(masked, axis=-1)
+    k = conf_size(mask)
+    pos = jnp.maximum(k - 1, 0) // 2
+    maj = jnp.take_along_axis(sorted_asc, pos[:, None], axis=-1)[:, 0]
+    small = jnp.array(jnp.iinfo(values.dtype).min, values.dtype)
+    return jnp.where(k > 0, maj, small)
+
+
+def combined_majority_min(values: jax.Array, conf_cur: jax.Array,
+                          conf_old: jax.Array) -> jax.Array:
+    """Joint-consensus combine: min over both confs when conf_old is active
+    (reference LeaderStateImpl.java:876 'combine' of MinMajorityMax)."""
+    maj_cur = majority_min(values, conf_cur)
+    in_joint = jnp.any(conf_old, axis=-1)
+    maj_old = majority_min(values, conf_old)
+    return jnp.where(in_joint, jnp.minimum(maj_cur, maj_old), maj_cur)
+
+
+class CommitUpdate(NamedTuple):
+    new_commit: jax.Array     # [G] advanced commit index
+    changed: jax.Array        # [G] bool: commit advanced this step
+
+
+def update_commit(match_index: jax.Array, self_mask: jax.Array,
+                  flush_index: jax.Array, conf_cur: jax.Array,
+                  conf_old: jax.Array, commit_index: jax.Array,
+                  first_leader_index: jax.Array,
+                  is_leader: jax.Array) -> CommitUpdate:
+    """Advance every group's commit index from follower matchIndexes.
+
+    Mirrors LeaderStateImpl.updateCommit:907 -> getMajorityMin:917:
+    the leader's own slot contributes its log *flush* index; the majority-min
+    over (current ∧ old) confs becomes the candidate commit; it only takes
+    effect if it reaches an entry of the current leader term — here encoded as
+    ``candidate >= first_leader_index`` (every index >= the leader's startup
+    placeholder entry has the leader's term, cf. StartupLogEntry:293), which
+    is the Raft §5.4.2 leader-completeness gate.
+
+    match_index: [G, P]; self_mask: [G, P] one-hot of the leader slot;
+    flush_index, commit_index, first_leader_index: [G]; is_leader: [G] bool.
+    """
+    eff = jnp.where(self_mask, flush_index[:, None], match_index)
+    candidate = combined_majority_min(eff, conf_cur, conf_old)
+    ok = is_leader & (candidate > commit_index) & (candidate >= first_leader_index)
+    new_commit = jnp.where(ok, candidate, commit_index)
+    return CommitUpdate(new_commit, ok)
+
+
+def all_replicated_min(match_index: jax.Array, self_mask: jax.Array,
+                       flush_index: jax.Array, conf_cur: jax.Array,
+                       conf_old: jax.Array) -> jax.Array:
+    """Per group, min index replicated on ALL members (for watch ALL /
+    ALL_COMMITTED levels, reference WatchRequests + LeaderStateImpl:986)."""
+    eff = jnp.where(self_mask, flush_index[:, None], match_index)
+    union = conf_cur | conf_old
+    big = jnp.array(jnp.iinfo(eff.dtype).max, eff.dtype)
+    vals = jnp.where(union, eff, big)
+    m = jnp.min(vals, axis=-1)
+    small = jnp.array(jnp.iinfo(eff.dtype).min, eff.dtype)
+    return jnp.where(jnp.any(union, axis=-1), m, small)
+
+
+class VoteTally(NamedTuple):
+    passed: jax.Array             # [G] bool: strict mid-stream PASS
+    passed_on_timeout: jax.Array  # [G] bool: PASS if the round deadline fires now
+    rejected: jax.Array           # [G] bool: reject majority or priority veto
+    decided: jax.Array            # [G] bool: passed | rejected
+
+
+def _has_majority(grants: jax.Array, mask: jax.Array) -> jax.Array:
+    cnt = jnp.sum(grants & mask, axis=-1)
+    return cnt >= majority_count(mask)
+
+
+def _majority_rejected(rejects: jax.Array, mask: jax.Array) -> jax.Array:
+    # Grant majority becomes impossible once ceil(size/2) members rejected
+    # (reference PeerConfiguration.majorityRejectVotes, PeerConfiguration.java:175).
+    cnt = jnp.sum(rejects & mask, axis=-1)
+    k = conf_size(mask)
+    return (k > 0) & (cnt >= (k + 1) // 2)
+
+
+def tally_votes(grants: jax.Array, rejects: jax.Array, conf_cur: jax.Array,
+                conf_old: jax.Array, priority: jax.Array,
+                self_priority: jax.Array) -> VoteTally:
+    """Tally one election round for every group.
+
+    Mirrors LeaderElection.waitForResults (LeaderElection.java:498-592):
+    - REJECTED: any *rejecting* member with priority > candidate priority
+      (the unconditional veto, LeaderElection.java:554-556), or a reject
+      majority in either active conf (majorityRejectVotes,
+      PeerConfiguration.java:175).
+    - ``passed`` (strict / mid-stream): grant majority in current conf AND
+      (if joint) old conf, AND every higher-priority member has replied
+      (``higherPriorityPeers.isEmpty()`` gate, LeaderElection.java:569-572),
+      and not rejected.
+    - ``passed_on_timeout``: majority and not rejected — the round-deadline
+      path where unresponsive higher-priority peers no longer block
+      (LeaderElection.java:515-519).  The engine picks this when the
+      election deadline fires.
+    The candidate's own grant must be pre-set in ``grants`` by the caller.
+    grants/rejects: [G, P] bool; priority: [G, P] int; self_priority: [G] int.
+    """
+    in_joint = jnp.any(conf_old, axis=-1)
+    pass_cur = _has_majority(grants, conf_cur)
+    pass_old = jnp.where(in_joint, _has_majority(grants, conf_old), True)
+    majority = pass_cur & pass_old
+
+    union = conf_cur | conf_old
+    higher = union & (priority > self_priority[:, None])
+    veto = jnp.any(rejects & higher, axis=-1)
+    rej_any = _majority_rejected(rejects, conf_cur) | (
+        in_joint & _majority_rejected(rejects, conf_old))
+    rejected = veto | rej_any
+
+    replied = grants | rejects
+    hp_all_replied = jnp.all(~higher | replied, axis=-1)
+    passed = majority & hp_all_replied & ~rejected
+    passed_on_timeout = majority & ~rejected
+    return VoteTally(passed, passed_on_timeout, rejected, passed | rejected)
+
+
+def election_timeout(now_ms: jax.Array, next_deadline_ms: jax.Array,
+                     is_follower: jax.Array) -> jax.Array:
+    """[G] bool: followers whose randomized election deadline has passed
+    (FollowerState.run's timeout check, FollowerState.java:64+)."""
+    return is_follower & (now_ms >= next_deadline_ms)
+
+
+def check_leadership(last_ack_ms: jax.Array, self_mask: jax.Array,
+                     conf_cur: jax.Array, conf_old: jax.Array,
+                     now_ms: jax.Array, timeout_ms: jax.Array,
+                     is_leader: jax.Array) -> jax.Array:
+    """[G] bool step-down mask: leaders that have NOT heard from a quorum
+    within the election timeout (LeaderStateImpl.checkLeadership:1096).
+
+    last_ack_ms: [G, P] last AppendEntries-reply time per peer; the leader's
+    own slot always counts as fresh.
+    """
+    eff = jnp.where(self_mask, now_ms, last_ack_ms)
+    # Majority-min of ack times = newest time a quorum acked at or after.
+    quorum_ack = combined_majority_min(eff, conf_cur, conf_old)
+    stale = (now_ms - quorum_ack) > timeout_ms
+    return is_leader & stale
+
+
+def lease_expiry(last_ack_ms: jax.Array, self_mask: jax.Array,
+                 conf_cur: jax.Array, conf_old: jax.Array,
+                 lease_timeout_ms: jax.Array) -> jax.Array:
+    """[G] lease expiry time: majority-ack timestamp + lease timeout
+    (reference LeaderLease.getMaxTimestampWithMajorityAck:90).  A leader may
+    serve reads locally while now < expiry."""
+    big = jnp.array(jnp.iinfo(last_ack_ms.dtype).max, last_ack_ms.dtype)
+    eff = jnp.where(self_mask, big, last_ack_ms)
+    quorum_ack = combined_majority_min(eff, conf_cur, conf_old)
+    # Saturating add: a single-member conf yields quorum_ack == dtype-max
+    # (lease forever); adding the timeout must not wrap negative.
+    return jnp.minimum(quorum_ack, big - lease_timeout_ms) + lease_timeout_ms
+
+
+def apply_ack_events(match_index: jax.Array, last_ack_ms: jax.Array,
+                     ev_group: jax.Array, ev_peer: jax.Array,
+                     ev_match: jax.Array, ev_time_ms: jax.Array,
+                     ev_valid: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Scatter a packed batch of AppendEntries acks into the state arrays.
+
+    This replaces the reference's per-stream AppendLogResponseHandler ->
+    FollowerInfo.updateMatchIndex -> EventQueue hop (GrpcLogAppender.java:475,
+    LeaderStateImpl.onFollowerSuccessAppendEntries:808): the transport layer
+    appends (group, peer, matchIndex, time) tuples to a ring buffer and the
+    engine flushes them here in one scatter-max.
+
+    ev_*: [E] padded event arrays; invalid slots must have ev_valid False.
+    matchIndex is monotone (scatter-max); ack time takes the max too.
+    """
+    small_i = jnp.array(jnp.iinfo(match_index.dtype).min, match_index.dtype)
+    small_t = jnp.array(jnp.iinfo(last_ack_ms.dtype).min, last_ack_ms.dtype)
+    m = jnp.where(ev_valid, ev_match, small_i)
+    t = jnp.where(ev_valid, ev_time_ms, small_t)
+    g = jnp.where(ev_valid, ev_group, 0)
+    p = jnp.where(ev_valid, ev_peer, 0)
+    new_match = match_index.at[g, p].max(m, mode="drop")
+    new_ack = last_ack_ms.at[g, p].max(t, mode="drop")
+    return new_match, new_ack
+
+
+def apply_vote_events(grants: jax.Array, rejects: jax.Array,
+                      ev_group: jax.Array, ev_peer: jax.Array,
+                      ev_granted: jax.Array, ev_valid: jax.Array
+                      ) -> tuple[jax.Array, jax.Array]:
+    """Scatter a packed batch of vote replies into grant/reject masks."""
+    g = jnp.where(ev_valid, ev_group, 0)
+    p = jnp.where(ev_valid, ev_peer, 0)
+    new_grants = grants.at[g, p].max(ev_valid & ev_granted, mode="drop")
+    new_rejects = rejects.at[g, p].max(ev_valid & ~ev_granted, mode="drop")
+    return new_grants, new_rejects

@@ -1,0 +1,113 @@
+"""Scalar (per-group, pure-Python) reference implementation of the quorum math.
+
+This is the readable specification of :mod:`ratis_tpu.ops.quorum` — a direct
+transliteration of the reference algorithms (LeaderStateImpl.getMajorityMin /
+MinMajorityMax.getMajority LeaderStateImpl.java:865-933,
+LeaderElection.waitForResults LeaderElection.java:498-592,
+RaftConfigurationImpl.hasMajority:265-281) operating on one group at a time.
+Used (a) as the differential-test oracle for the batched kernels and (b) as
+the small-G fast path where a device dispatch isn't worth the latency.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+INT_MIN = -(2 ** 31)
+
+
+def majority_count(size: int) -> int:
+    return size // 2 + 1
+
+
+def majority_min(values: Sequence[int], mask: Sequence[bool]) -> int:
+    """Greatest v such that a majority of members have value >= v."""
+    members = sorted(v for v, m in zip(values, mask) if m)
+    if not members:
+        return INT_MIN
+    return members[(len(members) - 1) // 2]
+
+
+def combined_majority_min(values: Sequence[int], conf_cur: Sequence[bool],
+                          conf_old: Sequence[bool]) -> int:
+    maj = majority_min(values, conf_cur)
+    if any(conf_old):
+        maj = min(maj, majority_min(values, conf_old))
+    return maj
+
+
+def update_commit(match_index: Sequence[int], self_slot: int, flush_index: int,
+                  conf_cur: Sequence[bool], conf_old: Sequence[bool],
+                  commit_index: int, first_leader_index: int,
+                  is_leader: bool) -> tuple[int, bool]:
+    eff = [flush_index if i == self_slot else v for i, v in enumerate(match_index)]
+    candidate = combined_majority_min(eff, conf_cur, conf_old)
+    if is_leader and candidate > commit_index and candidate >= first_leader_index:
+        return candidate, True
+    return commit_index, False
+
+
+def all_replicated_min(match_index: Sequence[int], self_slot: int,
+                       flush_index: int, conf_cur: Sequence[bool],
+                       conf_old: Sequence[bool]) -> int:
+    eff = [flush_index if i == self_slot else v for i, v in enumerate(match_index)]
+    union = [c or o for c, o in zip(conf_cur, conf_old)]
+    members = [v for v, m in zip(eff, union) if m]
+    return min(members) if members else INT_MIN
+
+
+def has_majority(grants: Sequence[bool], mask: Sequence[bool]) -> bool:
+    size = sum(mask)
+    cnt = sum(1 for g, m in zip(grants, mask) if g and m)
+    return cnt >= majority_count(size)
+
+
+def majority_rejected(rejects: Sequence[bool], mask: Sequence[bool]) -> bool:
+    size = sum(mask)
+    if size == 0:
+        return False
+    cnt = sum(1 for r, m in zip(rejects, mask) if r and m)
+    return cnt >= (size + 1) // 2
+
+
+def tally_votes(grants: Sequence[bool], rejects: Sequence[bool],
+                conf_cur: Sequence[bool], conf_old: Sequence[bool],
+                priority: Sequence[int], self_priority: int
+                ) -> tuple[bool, bool, bool]:
+    """Returns (passed, passed_on_timeout, rejected); see quorum.tally_votes."""
+    in_joint = any(conf_old)
+    majority = has_majority(grants, conf_cur) and (
+        not in_joint or has_majority(grants, conf_old))
+
+    union = [c or o for c, o in zip(conf_cur, conf_old)]
+    higher = [u and p > self_priority for u, p in zip(union, priority)]
+    veto = any(r and h for r, h in zip(rejects, higher))
+    rej = majority_rejected(rejects, conf_cur) or (
+        in_joint and majority_rejected(rejects, conf_old))
+    rejected = veto or rej
+
+    hp_all_replied = all((g or r) for g, r, h in zip(grants, rejects, higher) if h) \
+        if any(higher) else True
+    passed = majority and hp_all_replied and not rejected
+    passed_on_timeout = majority and not rejected
+    return passed, passed_on_timeout, rejected
+
+
+def check_leadership(last_ack_ms: Sequence[int], self_slot: int,
+                     conf_cur: Sequence[bool], conf_old: Sequence[bool],
+                     now_ms: int, timeout_ms: int, is_leader: bool) -> bool:
+    if not is_leader:
+        return False
+    eff = [now_ms if i == self_slot else v for i, v in enumerate(last_ack_ms)]
+    quorum_ack = combined_majority_min(eff, conf_cur, conf_old)
+    return (now_ms - quorum_ack) > timeout_ms
+
+
+def lease_expiry(last_ack_ms: Sequence[int], self_slot: int,
+                 conf_cur: Sequence[bool], conf_old: Sequence[bool],
+                 lease_timeout_ms: int, big: int = 2 ** 31 - 1) -> int:
+    """``big`` must be the dtype max of the engine's time arrays (int32 by
+    default) so this scalar path and the batched kernel agree exactly."""
+    eff = [big if i == self_slot else v for i, v in enumerate(last_ack_ms)]
+    quorum_ack = combined_majority_min(eff, conf_cur, conf_old)
+    return min(quorum_ack, big - lease_timeout_ms) + lease_timeout_ms

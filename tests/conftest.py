@@ -1,12 +1,30 @@
-"""Test environment: force an 8-virtual-device CPU platform BEFORE jax import,
-so multi-chip sharding paths are exercised without TPU hardware."""
+"""Test environment: force an 8-virtual-device CPU platform.
+
+The ambient environment registers a remote-TPU PJRT plugin ("axon") in every
+interpreter via sitecustomize, and that plugin's backend-init dials a tunnel
+(and claims the single real TPU) — unusable and unwanted for unit tests.
+Because sitecustomize already imported jax, env vars like JAX_PLATFORMS were
+snapshotted at interpreter start; the reliable switch is to (1) drop the axon
+backend factory before first backend init and (2) set the platform through
+jax.config.  XLA_FLAGS is still read at cpu-backend init, so the virtual
+8-device fleet can be requested here.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

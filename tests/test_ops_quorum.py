@@ -1,0 +1,278 @@
+"""Differential tests: batched quorum kernels vs the scalar reference.
+
+Strategy mirrors the build plan (SURVEY.md §7 step 4): the scalar module is a
+transliteration of the reference algorithms; hypothesis generates arbitrary
+[G, P] states and the jitted kernels must agree elementwise for every group.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from ratis_tpu.ops import quorum as q
+from ratis_tpu.ops import reference as ref
+
+P_MAX = 8
+G_FIXED = 4  # pad every generated batch to this many groups: one jit cache hit
+
+# jit once at module scope so each hypothesis example reuses the compiled fn
+_jmajority_min = jax.jit(q.majority_min)
+_jupdate_commit = jax.jit(q.update_commit)
+_jtally_votes = jax.jit(q.tally_votes)
+_jcheck_leadership = jax.jit(q.check_leadership)
+_jlease_expiry = jax.jit(q.lease_expiry)
+_jall_replicated_min = jax.jit(q.all_replicated_min)
+
+
+def _pad(groups):
+    """Repeat the last group until the batch has G_FIXED rows (static shape)."""
+    groups = list(groups)
+    while len(groups) < G_FIXED:
+        groups.append(groups[-1])
+    return groups[:G_FIXED]
+
+
+@st.composite
+def group_state(draw):
+    """One group's quorum-relevant state with realistic invariants."""
+    n = draw(st.integers(1, P_MAX))
+    conf_cur = [draw(st.booleans()) for _ in range(n)] + [False] * (P_MAX - n)
+    if not any(conf_cur):
+        conf_cur[draw(st.integers(0, n - 1))] = True
+    joint = draw(st.booleans())
+    conf_old = [False] * P_MAX
+    if joint:
+        conf_old = [draw(st.booleans()) for _ in range(n)] + [False] * (P_MAX - n)
+    match = [draw(st.integers(-1, 50)) for _ in range(P_MAX)]
+    self_slot = draw(st.integers(0, n - 1))
+    return {
+        "conf_cur": conf_cur, "conf_old": conf_old, "match": match,
+        "self_slot": self_slot,
+        "flush": draw(st.integers(-1, 60)),
+        "commit": draw(st.integers(-1, 40)),
+        "first_leader_index": draw(st.integers(0, 30)),
+        "is_leader": draw(st.booleans()),
+        "grants": [draw(st.booleans()) for _ in range(P_MAX)],
+        "rejects": [draw(st.booleans()) for _ in range(P_MAX)],
+        "priority": [draw(st.integers(0, 3)) for _ in range(P_MAX)],
+        "self_priority": draw(st.integers(0, 3)),
+        "last_ack": [draw(st.integers(0, 1000)) for _ in range(P_MAX)],
+    }
+
+
+def _batch(groups, key, dtype=np.int32):
+    return jnp.asarray(np.array([g[key] for g in groups], dtype=dtype))
+
+
+def _self_mask(groups):
+    m = np.zeros((len(groups), P_MAX), dtype=bool)
+    for i, g in enumerate(groups):
+        m[i, g["self_slot"]] = True
+    return jnp.asarray(m)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(group_state(), min_size=1, max_size=4))
+def test_majority_min_differential(groups):
+    groups = _pad(groups)
+    got = np.asarray(_jmajority_min(_batch(groups, "match"),
+                                    _batch(groups, "conf_cur", bool)))
+    for i, g in enumerate(groups):
+        assert got[i] == ref.majority_min(g["match"], g["conf_cur"]), g
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(group_state(), min_size=1, max_size=4))
+def test_update_commit_differential(groups):
+    groups = _pad(groups)
+    out = _jupdate_commit(
+        _batch(groups, "match"), _self_mask(groups), _batch(groups, "flush"),
+        _batch(groups, "conf_cur", bool), _batch(groups, "conf_old", bool),
+        _batch(groups, "commit"), _batch(groups, "first_leader_index"),
+        _batch(groups, "is_leader", bool))
+    for i, g in enumerate(groups):
+        want_commit, want_changed = ref.update_commit(
+            g["match"], g["self_slot"], g["flush"], g["conf_cur"],
+            g["conf_old"], g["commit"], g["first_leader_index"], g["is_leader"])
+        assert int(out.new_commit[i]) == want_commit, g
+        assert bool(out.changed[i]) == want_changed, g
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(group_state(), min_size=1, max_size=4))
+def test_tally_votes_differential(groups):
+    groups = _pad(groups)
+    out = _jtally_votes(
+        _batch(groups, "grants", bool), _batch(groups, "rejects", bool),
+        _batch(groups, "conf_cur", bool), _batch(groups, "conf_old", bool),
+        _batch(groups, "priority"), _batch(groups, "self_priority"))
+    for i, g in enumerate(groups):
+        want_pass, want_pass_to, want_rej = ref.tally_votes(
+            g["grants"], g["rejects"], g["conf_cur"], g["conf_old"],
+            g["priority"], g["self_priority"])
+        assert bool(out.passed[i]) == want_pass, g
+        assert bool(out.passed_on_timeout[i]) == want_pass_to, g
+        assert bool(out.rejected[i]) == want_rej, g
+        assert bool(out.decided[i]) == (want_pass or want_rej)
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.lists(group_state(), min_size=1, max_size=4),
+       st.integers(0, 2000), st.integers(1, 500))
+def test_check_leadership_differential(groups, now, timeout):
+    groups = _pad(groups)
+    got = np.asarray(_jcheck_leadership(
+        _batch(groups, "last_ack"), _self_mask(groups),
+        _batch(groups, "conf_cur", bool), _batch(groups, "conf_old", bool),
+        jnp.int32(now), jnp.int32(timeout), _batch(groups, "is_leader", bool)))
+    for i, g in enumerate(groups):
+        want = ref.check_leadership(g["last_ack"], g["self_slot"],
+                                    g["conf_cur"], g["conf_old"], now, timeout,
+                                    g["is_leader"])
+        assert bool(got[i]) == want, g
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.lists(group_state(), min_size=1, max_size=4), st.integers(1, 500))
+def test_lease_expiry_differential(groups, lease_ms):
+    groups = _pad(groups)
+    got = np.asarray(_jlease_expiry(
+        _batch(groups, "last_ack"), _self_mask(groups),
+        _batch(groups, "conf_cur", bool), _batch(groups, "conf_old", bool),
+        jnp.int32(lease_ms)))
+    for i, g in enumerate(groups):
+        want = ref.lease_expiry(g["last_ack"], g["self_slot"], g["conf_cur"],
+                                g["conf_old"], lease_ms)
+        assert int(got[i]) == want, g
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.lists(group_state(), min_size=1, max_size=4))
+def test_all_replicated_min_differential(groups):
+    groups = _pad(groups)
+    got = np.asarray(_jall_replicated_min(
+        _batch(groups, "match"), _self_mask(groups), _batch(groups, "flush"),
+        _batch(groups, "conf_cur", bool), _batch(groups, "conf_old", bool)))
+    for i, g in enumerate(groups):
+        want = ref.all_replicated_min(g["match"], g["self_slot"], g["flush"],
+                                      g["conf_cur"], g["conf_old"])
+        assert int(got[i]) == want, g
+
+
+class TestKnownCases:
+    """Hand-checked cases pinned from the reference semantics."""
+
+    def test_five_peer_median(self):
+        # matchIndexes [9, 5, 7, 2, 8] -> majority-min is 7 (3 peers >= 7)
+        vals = jnp.asarray([[9, 5, 7, 2, 8, 0, 0, 0]], dtype=jnp.int32)
+        mask = jnp.asarray([[1, 1, 1, 1, 1, 0, 0, 0]], dtype=bool)
+        assert int(q.majority_min(vals, mask)[0]) == 7
+
+    def test_term_gate_blocks_old_term_commit(self):
+        # Majority index 5 but leader's first index this term is 6: no commit
+        # (Raft §5.4.2; reference updateCommit's term check).
+        out = _jupdate_commit(
+            jnp.asarray([[5, 5, 0, 0, 0, 0, 0, 0]], jnp.int32),
+            jnp.asarray([[0, 0, 1, 0, 0, 0, 0, 0]], bool),
+            jnp.asarray([9], jnp.int32),
+            jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], bool),
+            jnp.zeros((1, 8), bool),
+            jnp.asarray([2], jnp.int32), jnp.asarray([6], jnp.int32),
+            jnp.asarray([True]))
+        assert int(out.new_commit[0]) == 2 and not bool(out.changed[0])
+
+    def test_joint_consensus_needs_both(self):
+        # grants majority in new conf only -> not passed while joint.
+        grants = jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], bool)
+        conf_cur = jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], bool)
+        conf_old = jnp.asarray([[0, 0, 1, 1, 1, 0, 0, 0]], bool)
+        out = _jtally_votes(grants, jnp.zeros((1, 8), bool), conf_cur,
+                            conf_old, jnp.zeros((1, 8), jnp.int32),
+                            jnp.zeros(1, jnp.int32))
+        assert not bool(out.passed[0])
+
+    def test_priority_veto_beats_majority(self):
+        # 2-of-3 grants BUT the rejecting peer has higher priority: REJECTED
+        # unconditionally (LeaderElection.java:554-556).
+        grants = jnp.asarray([[1, 1, 0, 0, 0, 0, 0, 0]], bool)
+        rejects = jnp.asarray([[0, 0, 1, 0, 0, 0, 0, 0]], bool)
+        conf = jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], bool)
+        prio = jnp.asarray([[0, 0, 5, 0, 0, 0, 0, 0]], jnp.int32)
+        out = _jtally_votes(grants, rejects, conf, jnp.zeros((1, 8), bool),
+                            prio, jnp.zeros(1, jnp.int32))
+        assert bool(out.rejected[0])
+        assert not bool(out.passed[0]) and not bool(out.passed_on_timeout[0])
+
+    def test_unreplied_higher_priority_blocks_until_timeout(self):
+        # Majority granted, higher-priority peer silent: strict pass blocked
+        # (higherPriorityPeers.isEmpty() gate, LeaderElection.java:569-572)
+        # but the round-deadline path passes (LeaderElection.java:515-519).
+        grants = jnp.asarray([[1, 1, 0, 0, 0, 0, 0, 0]], bool)
+        rejects = jnp.zeros((1, 8), bool)
+        conf = jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], bool)
+        prio = jnp.asarray([[0, 0, 5, 0, 0, 0, 0, 0]], jnp.int32)
+        out = _jtally_votes(grants, rejects, conf, jnp.zeros((1, 8), bool),
+                            prio, jnp.zeros(1, jnp.int32))
+        assert not bool(out.passed[0])
+        assert bool(out.passed_on_timeout[0])
+        # once the higher-priority peer replies with a grant, strict pass:
+        grants2 = jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], bool)
+        out2 = _jtally_votes(grants2, rejects, conf, jnp.zeros((1, 8), bool),
+                             prio, jnp.zeros(1, jnp.int32))
+        assert bool(out2.passed[0])
+
+
+class TestEventPacking:
+    def test_ack_scatter_max(self):
+        match = jnp.zeros((3, 4), jnp.int32)
+        ack = jnp.zeros((3, 4), jnp.int32)
+        # two acks for (g1,p2): 7 then 5 -> keeps 7; invalid slot ignored
+        evg = jnp.asarray([1, 1, 2, 0], jnp.int32)
+        evp = jnp.asarray([2, 2, 3, 0], jnp.int32)
+        evm = jnp.asarray([7, 5, 9, 100], jnp.int32)
+        evt = jnp.asarray([10, 20, 30, 999], jnp.int32)
+        valid = jnp.asarray([True, True, True, False])
+        m2, a2 = q.apply_ack_events(match, ack, evg, evp, evm, evt, valid)
+        assert int(m2[1, 2]) == 7 and int(a2[1, 2]) == 20
+        assert int(m2[2, 3]) == 9
+        assert int(m2[0, 0]) == 0 and int(a2[0, 0]) == 0
+
+    def test_vote_scatter(self):
+        g = jnp.zeros((2, 3), bool)
+        r = jnp.zeros((2, 3), bool)
+        evg = jnp.asarray([0, 1, 0], jnp.int32)
+        evp = jnp.asarray([1, 2, 0], jnp.int32)
+        granted = jnp.asarray([True, False, True])
+        valid = jnp.asarray([True, True, False])
+        g2, r2 = q.apply_vote_events(g, r, evg, evp, granted, valid)
+        assert bool(g2[0, 1]) and not bool(r2[0, 1])
+        assert bool(r2[1, 2]) and not bool(g2[1, 2])
+        assert not bool(g2[0, 0])  # invalid dropped
+
+
+def test_kernels_jit_and_batch_10k():
+    """The whole point: 10k groups advance in one jitted dispatch."""
+    G, P = 10000, 5
+    rng = np.random.default_rng(0)
+    match = jnp.asarray(rng.integers(0, 100, (G, P)), jnp.int32)
+    self_mask = jnp.asarray(np.eye(P, dtype=bool)[rng.integers(0, P, G)])
+    flush = jnp.asarray(rng.integers(0, 100, G), jnp.int32)
+    conf = jnp.ones((G, P), bool)
+    conf_old = jnp.zeros((G, P), bool)
+    commit = jnp.zeros(G, jnp.int32)
+    first = jnp.zeros(G, jnp.int32)
+    leader = jnp.ones(G, bool)
+
+    step = jax.jit(q.update_commit)
+    out = step(match, self_mask, flush, conf, conf_old, commit, first, leader)
+    out.new_commit.block_until_ready()
+    assert out.new_commit.shape == (G,)
+    # spot-check one group against the scalar reference
+    i = 1234
+    want, _ = ref.update_commit(
+        [int(x) for x in np.asarray(match[i])], int(np.argmax(self_mask[i])),
+        int(flush[i]), [True] * P, [False] * P, 0, 0, True)
+    assert int(out.new_commit[i]) == want
