@@ -1,0 +1,291 @@
+"""QuorumEngine: one tick loop advances every group's consensus math.
+
+This is the replacement for the reference's thread-per-division daemons
+(FollowerState timeout thread FollowerState.java:64, LeaderStateImpl
+EventProcessor LeaderStateImpl.java:108-190): a single asyncio task per
+server drains packed ack events and, in one pass over the group batch,
+
+- advances leader commit indexes (ops.quorum.update_commit),
+- fires follower election timeouts (ops.quorum.election_timeout),
+- detects stale leadership (ops.quorum.check_leadership),
+
+then invokes per-division callbacks for the few groups whose state changed.
+Below ``scalar_fallback_threshold`` active groups the same math runs through
+:mod:`ratis_tpu.ops.reference` (no device dispatch); above it, the jitted
+kernels take over (the 10k-group path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional, Protocol
+
+import numpy as np
+
+from ratis_tpu.engine.state import (GroupBatchState, NO_DEADLINE,
+                                    ROLE_CANDIDATE, ROLE_FOLLOWER,
+                                    ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
+from ratis_tpu.ops import reference as ref
+
+
+class EngineListener(Protocol):
+    """What a division implements to be driven by the engine."""
+
+    async def on_election_timeout(self) -> None: ...
+
+    async def on_commit_advance(self, new_commit: int) -> None: ...
+
+    async def on_leadership_stale(self) -> None: ...
+
+
+class Clock:
+    """Millisecond clock relative to a movable epoch (int32-friendly).
+
+    The epoch advances when the engine rebases (see
+    QuorumEngine._maybe_rebase_epoch), keeping now_ms well inside int32 for
+    arbitrarily long uptimes."""
+
+    def __init__(self):
+        self._t0 = time.monotonic()
+
+    def now_ms(self) -> int:
+        return int((time.monotonic() - self._t0) * 1000)
+
+    def advance_epoch(self, delta_ms: int) -> None:
+        self._t0 += delta_ms / 1000.0
+
+
+class QuorumEngine:
+    def __init__(self, max_groups: int = 1024, max_peers: int = 8,
+                 tick_interval_s: float = 0.002,
+                 scalar_fallback_threshold: int = 16,
+                 leadership_timeout_ms: int = 300,
+                 use_device: bool = False):
+        self.state = GroupBatchState(max_groups, max_peers)
+        self.clock = Clock()
+        self.tick_interval_s = tick_interval_s
+        self.scalar_fallback_threshold = scalar_fallback_threshold
+        self.leadership_timeout_ms = leadership_timeout_ms
+        self.use_device = use_device
+        self._listeners: dict[int, EngineListener] = {}
+        self._ack_ring: list[tuple[int, int, int, int]] = []  # (slot, peer, match, t)
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._running = False
+        self._jit_cache: dict = {}
+        self.metrics = {"ticks": 0, "acks": 0, "commit_advances": 0,
+                        "batched_dispatches": 0}
+
+    # -- registration --------------------------------------------------------
+
+    def attach(self, listener: EngineListener) -> int:
+        slot = self.state.allocate()
+        self._listeners[slot] = listener
+        return slot
+
+    def detach(self, slot: int) -> None:
+        self._listeners.pop(slot, None)
+        self.state.release(slot)
+
+    # -- event intake (transport/appender threads call these) ---------------
+
+    def on_ack(self, slot: int, peer_slot: int, match_index: int) -> None:
+        self._ack_ring.append((slot, peer_slot, match_index, self.clock.now_ms()))
+        self._wake.set()
+
+    def notify(self) -> None:
+        """Wake the tick loop early (e.g. flush index advanced)."""
+        self._wake.set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._running = True
+        self._task = asyncio.create_task(self._run(), name="quorum-engine")
+
+    async def close(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._wake.set()
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while self._running:
+            try:
+                await asyncio.wait_for(self._wake.wait(), self.tick_interval_s)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            await self.tick()
+
+    # -- the tick ------------------------------------------------------------
+
+    # Rebase when now_ms passes this (half of int32 max, lots of margin).
+    _REBASE_THRESHOLD_MS = 1 << 30
+    _REBASE_KEEP_MS = 3_600_000  # keep the last hour of history meaningful
+
+    def _maybe_rebase_epoch(self, now: int) -> int:
+        """Shift the clock epoch forward and subtract the delta from every
+        stored time so int32 never wraps (see ops.quorum time convention)."""
+        if now < self._REBASE_THRESHOLD_MS:
+            return now
+        s = self.state
+        delta = now - self._REBASE_KEEP_MS
+        self.clock.advance_epoch(delta)
+        s.last_ack_ms -= np.int32(delta)
+        np.maximum(s.last_ack_ms, 0, out=s.last_ack_ms)
+        mask = s.election_deadline_ms != NO_DEADLINE
+        s.election_deadline_ms[mask] -= np.int32(delta)
+        self._ack_ring = [(g, p, m, max(0, t - delta))
+                          for g, p, m, t in self._ack_ring]
+        return now - delta
+
+    async def tick(self) -> None:
+        s = self.state
+        now = self._maybe_rebase_epoch(self.clock.now_ms())
+        self.metrics["ticks"] += 1
+
+        acks = self._ack_ring
+        self._ack_ring = []
+        self.metrics["acks"] += len(acks)
+
+        active = s.active
+        if not active:
+            return
+
+        use_batched = (self.use_device
+                       or len(active) >= self.scalar_fallback_threshold)
+        if use_batched:
+            changed = self._tick_batched(acks, now)
+        else:
+            changed = self._tick_scalar(acks, now)
+
+        # dispatch callbacks outside the math pass
+        for slot, kind, value in changed:
+            listener = self._listeners.get(slot)
+            if listener is None:
+                continue
+            if kind == "commit":
+                self.metrics["commit_advances"] += 1
+                await listener.on_commit_advance(value)
+            elif kind == "timeout":
+                await listener.on_election_timeout()
+            elif kind == "stale":
+                await listener.on_leadership_stale()
+
+    # -- scalar path ---------------------------------------------------------
+
+    def _tick_scalar(self, acks, now: int) -> list[tuple[int, str, int]]:
+        s = self.state
+        changed: list[tuple[int, str, int]] = []
+        touched: set[int] = set()
+        for slot, peer, match, t in acks:
+            if s.match_index[slot, peer] < match:
+                s.match_index[slot, peer] = match
+            if s.last_ack_ms[slot, peer] < t:
+                s.last_ack_ms[slot, peer] = t
+            touched.add(slot)
+
+        for slot in list(s.active):
+            role = s.role[slot]
+            if role == ROLE_LEADER and (slot in touched or True):
+                new_commit, did = ref.update_commit(
+                    s.match_index[slot].tolist(), int(s.self_slot[slot]),
+                    int(s.flush_index[slot]), s.conf_cur[slot].tolist(),
+                    s.conf_old[slot].tolist(), int(s.commit_index[slot]),
+                    int(s.first_leader_index[slot]), True)
+                if did:
+                    s.commit_index[slot] = new_commit
+                    changed.append((slot, "commit", new_commit))
+                if ref.check_leadership(
+                        s.last_ack_ms[slot].tolist(), int(s.self_slot[slot]),
+                        s.conf_cur[slot].tolist(), s.conf_old[slot].tolist(),
+                        now, self.leadership_timeout_ms, True):
+                    changed.append((slot, "stale", 0))
+            elif role == ROLE_FOLLOWER and now >= s.election_deadline_ms[slot]:
+                s.election_deadline_ms[slot] = NO_DEADLINE  # re-armed by div
+                changed.append((slot, "timeout", 0))
+        return changed
+
+    # -- batched path --------------------------------------------------------
+
+    def _kernels(self):
+        if "step" not in self._jit_cache:
+            import jax
+            import jax.numpy as jnp
+            from ratis_tpu.ops import quorum as q
+
+            def step(match, last_ack, evg, evp, evm, evt, evv, self_mask,
+                     flush, conf_cur, conf_old, commit, first, role, deadline,
+                     now, lead_timeout):
+                match, last_ack = q.apply_ack_events(match, last_ack, evg, evp,
+                                                     evm, evt, evv)
+                is_leader = role == ROLE_LEADER
+                cu = q.update_commit(match, self_mask, flush, conf_cur,
+                                     conf_old, commit, first, is_leader)
+                timeouts = q.election_timeout(now, deadline,
+                                              role == ROLE_FOLLOWER)
+                stale = q.check_leadership(last_ack, self_mask, conf_cur,
+                                           conf_old, now, lead_timeout,
+                                           is_leader)
+                return match, last_ack, cu.new_commit, cu.changed, timeouts, stale
+
+            self._jit_cache["step"] = jax.jit(step)
+        return self._jit_cache["step"]
+
+    def _tick_batched(self, acks, now: int) -> list[tuple[int, str, int]]:
+        import jax.numpy as jnp
+
+        s = self.state
+        self.metrics["batched_dispatches"] += 1
+        # pad event arrays to a power-of-two length (shape-stable jit)
+        n = max(1, len(acks))
+        cap = 1 << (n - 1).bit_length()
+        evg = np.zeros(cap, np.int32)
+        evp = np.zeros(cap, np.int32)
+        evm = np.zeros(cap, np.int32)
+        evt = np.zeros(cap, np.int32)
+        evv = np.zeros(cap, bool)
+        for i, (slot, peer, match, t) in enumerate(acks):
+            evg[i], evp[i], evm[i], evt[i], evv[i] = slot, peer, match, t, True
+
+        step = self._kernels()
+        match, last_ack, new_commit, commit_changed, timeouts, stale = step(
+            jnp.asarray(s.match_index), jnp.asarray(s.last_ack_ms),
+            jnp.asarray(evg), jnp.asarray(evp), jnp.asarray(evm),
+            jnp.asarray(evt), jnp.asarray(evv), jnp.asarray(s.self_mask),
+            jnp.asarray(s.flush_index), jnp.asarray(s.conf_cur),
+            jnp.asarray(s.conf_old), jnp.asarray(s.commit_index),
+            jnp.asarray(s.first_leader_index), jnp.asarray(s.role),
+            jnp.asarray(s.election_deadline_ms), jnp.int32(now),
+            jnp.int32(self.leadership_timeout_ms))
+
+        s.match_index = np.asarray(match)
+        s.last_ack_ms = np.asarray(last_ack)
+        new_commit_np = np.asarray(new_commit)
+        commit_changed_np = np.asarray(commit_changed)
+        timeouts_np = np.asarray(timeouts)
+        stale_np = np.asarray(stale)
+
+        changed: list[tuple[int, str, int]] = []
+        for slot in np.nonzero(commit_changed_np)[0]:
+            i = int(slot)
+            if i in s.active:
+                s.commit_index[i] = int(new_commit_np[i])
+                changed.append((i, "commit", int(new_commit_np[i])))
+        for slot in np.nonzero(timeouts_np)[0]:
+            i = int(slot)
+            if i in s.active:
+                s.election_deadline_ms[i] = NO_DEADLINE
+                changed.append((i, "timeout", 0))
+        for slot in np.nonzero(stale_np)[0]:
+            i = int(slot)
+            if i in s.active:
+                changed.append((i, "stale", 0))
+        return changed

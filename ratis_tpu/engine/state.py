@@ -1,0 +1,108 @@
+"""GroupBatchState: struct-of-arrays consensus state for every hosted group.
+
+This replaces the reference's per-division mutable objects
+(FollowerInfo nextIndex/matchIndex/lastRpcTime, LeaderStateImpl's
+commit bookkeeping, FollowerState's election deadline) with ``[G, P]`` numpy
+arrays managed by a slot free-list, so the whole server's consensus state is
+one tensor batch — the multi-Raft fan-in point (RaftServerProxy.ImplMap,
+RaftServerProxy.java:89) becomes an array axis.
+
+Times are int32 milliseconds since engine start.  Indices are int32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+# role codes (device-friendly int8)
+ROLE_UNUSED = 0
+ROLE_FOLLOWER = 1
+ROLE_CANDIDATE = 2
+ROLE_LEADER = 3
+ROLE_LISTENER = 4
+
+NO_DEADLINE = np.iinfo(np.int32).max
+
+
+class GroupBatchState:
+    def __init__(self, max_groups: int = 1024, max_peers: int = 8):
+        g, p = max_groups, max_peers
+        self.capacity = g
+        self.max_peers = p
+        self.role = np.zeros(g, np.int8)
+        self.self_slot = np.zeros(g, np.int8)
+        self.self_mask = np.zeros((g, p), bool)
+        self.conf_cur = np.zeros((g, p), bool)
+        self.conf_old = np.zeros((g, p), bool)
+        self.priority = np.zeros((g, p), np.int32)
+        self.self_priority = np.zeros(g, np.int32)
+        self.match_index = np.full((g, p), -1, np.int32)
+        self.next_index = np.zeros((g, p), np.int32)
+        self.flush_index = np.full(g, -1, np.int32)
+        self.commit_index = np.full(g, -1, np.int32)
+        self.first_leader_index = np.zeros(g, np.int32)
+        self.last_ack_ms = np.zeros((g, p), np.int32)
+        self.election_deadline_ms = np.full(g, NO_DEADLINE, np.int32)
+        self._free: list[int] = list(range(g - 1, -1, -1))
+        self.active: set[int] = set()
+
+    def allocate(self) -> int:
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        self.active.add(slot)
+        return slot
+
+    def release(self, slot: int) -> None:
+        self.active.discard(slot)
+        self.role[slot] = ROLE_UNUSED
+        self.conf_cur[slot] = False
+        self.conf_old[slot] = False
+        self.self_mask[slot] = False
+        self.match_index[slot] = -1
+        self.flush_index[slot] = -1
+        self.commit_index[slot] = -1
+        self.election_deadline_ms[slot] = NO_DEADLINE
+        self._free.append(slot)
+
+    def _grow(self) -> None:
+        """Double capacity (pad arrays); jit caches per shape, and doubling
+        keeps the number of distinct compiled shapes logarithmic."""
+        old = self.capacity
+        new = old * 2
+        for name in ("role", "self_slot", "flush_index", "commit_index",
+                     "first_leader_index", "election_deadline_ms",
+                     "self_priority"):
+            a = getattr(self, name)
+            b = np.zeros(new, a.dtype)
+            b[:old] = a
+            if name == "flush_index" or name == "commit_index":
+                b[old:] = -1
+            if name == "election_deadline_ms":
+                b[old:] = NO_DEADLINE
+            setattr(self, name, b)
+        for name in ("self_mask", "conf_cur", "conf_old", "priority",
+                     "match_index", "next_index", "last_ack_ms"):
+            a = getattr(self, name)
+            b = np.zeros((new, self.max_peers), a.dtype)
+            b[:old] = a
+            if name == "match_index":
+                b[old:] = -1
+            setattr(self, name, b)
+        self._free.extend(range(new - 1, old - 1, -1))
+        self.capacity = new
+
+    # -- per-group setters used by divisions --------------------------------
+
+    def set_conf(self, slot: int, self_slot: int, cur_mask, old_mask,
+                 priorities, self_priority: int) -> None:
+        self.self_slot[slot] = self_slot
+        self.self_mask[slot] = False
+        self.self_mask[slot, self_slot] = True
+        self.conf_cur[slot] = cur_mask
+        self.conf_old[slot] = old_mask
+        self.priority[slot] = priorities
+        self.self_priority[slot] = self_priority

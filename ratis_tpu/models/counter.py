@@ -1,0 +1,60 @@
+"""CounterStateMachine: the minimal demo/test state machine.
+
+Capability parity with the reference counter example
+(ratis-examples/.../counter/server/CounterStateMachine.java:63):
+INCREMENT via applyTransaction (:263), GET via query (:234), snapshot as the
+serialized counter (takeSnapshot:160).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.termindex import INVALID_LOG_INDEX
+from ratis_tpu.server.statemachine import (SnapshotInfo, StateMachine,
+                                           TransactionContext)
+
+INCREMENT = b"INCREMENT"
+GET = b"GET"
+
+
+class CounterStateMachine(StateMachine):
+    def __init__(self):
+        super().__init__()
+        self.counter = 0
+
+    async def start_transaction(self, request) -> TransactionContext:
+        if request.message.content != INCREMENT:
+            trx = TransactionContext(client_request=request)
+            trx.exception = ValueError(
+                f"invalid command {request.message.content!r}; "
+                f"only {INCREMENT!r} is a write")
+            return trx
+        return TransactionContext(client_request=request,
+                                  log_data=request.message.content)
+
+    async def apply_transaction(self, trx: TransactionContext) -> Message:
+        self.counter += 1
+        e = trx.log_entry
+        if e is not None:
+            self.update_last_applied_term_index(e.term, e.index)
+        return Message.value_of(str(self.counter))
+
+    async def query(self, request: Message) -> Message:
+        if request.content != GET:
+            raise ValueError(f"invalid query {request.content!r}")
+        return Message.value_of(str(self.counter))
+
+    async def take_snapshot(self) -> int:
+        ti = self.get_last_applied_term_index()
+        if ti.index == INVALID_LOG_INDEX:
+            return INVALID_LOG_INDEX
+        path = self._storage.snapshot_path(ti.term, ti.index)
+        path.write_bytes(struct.pack(">q", self.counter))
+        return ti.index
+
+    async def restore_from_snapshot(self, snapshot: SnapshotInfo) -> None:
+        import pathlib
+        path = pathlib.Path(snapshot.files[0].path)
+        (self.counter,) = struct.unpack(">q", path.read_bytes())

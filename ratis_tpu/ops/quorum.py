@@ -18,7 +18,12 @@ Conventions:
   ``conf_cur`` and ``conf_old`` (all-False when not in joint mode).
   Listeners are simply never in a mask.
 - Indices are integer arrays (int32 by default, dtype-polymorphic).
-- Times are integer milliseconds since engine start (exact, TPU-friendly).
+- Times are int32 milliseconds since the engine's *epoch*.  int32 would wrap
+  after ~24.8 days, so the engine periodically REBASES the epoch (shifts its
+  clock origin and subtracts the same delta from every stored time array,
+  QuorumEngine._maybe_rebase_epoch) — comparisons here are all relative, so
+  a uniform shift is invisible to the kernels.  int64 on device would require
+  jax x64 mode (which silently downcasts otherwise) and is emulated on TPU.
 - All functions are total: group slots that are unused/not-leader must be
   masked by the caller (the engine passes role masks).
 """
@@ -240,9 +245,20 @@ def apply_vote_events(grants: jax.Array, rejects: jax.Array,
                       ev_group: jax.Array, ev_peer: jax.Array,
                       ev_granted: jax.Array, ev_valid: jax.Array
                       ) -> tuple[jax.Array, jax.Array]:
-    """Scatter a packed batch of vote replies into grant/reject masks."""
+    """Scatter a packed batch of vote replies into grant/reject masks.
+
+    First reply wins (the reference ignores duplicates,
+    LeaderElection.waitForResults responses.putIfAbsent): an event for a peer
+    that already replied in this round is dropped, so a retransmitted or
+    flip-flopped reply can never mark a peer as both granting and rejecting.
+    The host-side packer must additionally dedupe (group, peer) WITHIN one
+    batch (keep the first) — two same-peer events in a single batch would
+    otherwise both pass this gate.
+    """
     g = jnp.where(ev_valid, ev_group, 0)
     p = jnp.where(ev_valid, ev_peer, 0)
-    new_grants = grants.at[g, p].max(ev_valid & ev_granted, mode="drop")
-    new_rejects = rejects.at[g, p].max(ev_valid & ~ev_granted, mode="drop")
+    already = (grants | rejects)[g, p]
+    ok = ev_valid & ~already
+    new_grants = grants.at[g, p].max(ok & ev_granted, mode="drop")
+    new_rejects = rejects.at[g, p].max(ok & ~ev_granted, mode="drop")
     return new_grants, new_rejects

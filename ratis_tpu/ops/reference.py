@@ -13,6 +13,9 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+# Sentinel for empty confs; matches the batched kernels' dtype-min for the
+# engine's default int32 index arrays.  Callers using another dtype must pass
+# a matching ``empty`` so the scalar fast path and the kernel agree exactly.
 INT_MIN = -(2 ** 31)
 
 
@@ -20,11 +23,12 @@ def majority_count(size: int) -> int:
     return size // 2 + 1
 
 
-def majority_min(values: Sequence[int], mask: Sequence[bool]) -> int:
+def majority_min(values: Sequence[int], mask: Sequence[bool],
+                 empty: int = INT_MIN) -> int:
     """Greatest v such that a majority of members have value >= v."""
     members = sorted(v for v, m in zip(values, mask) if m)
     if not members:
-        return INT_MIN
+        return empty
     return members[(len(members) - 1) // 2]
 
 
@@ -49,11 +53,11 @@ def update_commit(match_index: Sequence[int], self_slot: int, flush_index: int,
 
 def all_replicated_min(match_index: Sequence[int], self_slot: int,
                        flush_index: int, conf_cur: Sequence[bool],
-                       conf_old: Sequence[bool]) -> int:
+                       conf_old: Sequence[bool], empty: int = INT_MIN) -> int:
     eff = [flush_index if i == self_slot else v for i, v in enumerate(match_index)]
     union = [c or o for c, o in zip(conf_cur, conf_old)]
     members = [v for v, m in zip(eff, union) if m]
-    return min(members) if members else INT_MIN
+    return min(members) if members else empty
 
 
 def has_majority(grants: Sequence[bool], mask: Sequence[bool]) -> bool:

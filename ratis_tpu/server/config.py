@@ -1,0 +1,149 @@
+"""RaftConfiguration: the (possibly joint) peer membership of one group.
+
+Capability parity with the reference RaftConfigurationImpl /
+PeerConfiguration (ratis-server/.../impl/RaftConfigurationImpl.java,
+PeerConfiguration.java:42): current + optional old conf (joint consensus),
+listener exclusion from quorum, majority checks in BOTH confs
+(hasMajority:265-281), and the log index the conf was committed at.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.logentry import ConfigurationEntry, LogEntry, make_config_entry
+from ratis_tpu.protocol.peer import RaftPeer, RaftPeerRole
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerConfiguration:
+    """One conf: voting peers + listeners."""
+
+    peers: tuple[RaftPeer, ...] = ()
+    listeners: tuple[RaftPeer, ...] = ()
+
+    def contains(self, peer_id: RaftPeerId) -> bool:
+        return any(p.id == peer_id for p in self.peers)
+
+    def contains_listener(self, peer_id: RaftPeerId) -> bool:
+        return any(p.id == peer_id for p in self.listeners)
+
+    def get(self, peer_id: RaftPeerId) -> Optional[RaftPeer]:
+        for p in self.peers:
+            if p.id == peer_id:
+                return p
+        for p in self.listeners:
+            if p.id == peer_id:
+                return p
+        return None
+
+    def size(self) -> int:
+        return len(self.peers)
+
+    def has_majority(self, voted: Iterable[RaftPeerId]) -> bool:
+        voted_set = set(voted)
+        count = sum(1 for p in self.peers if p.id in voted_set)
+        return count >= self.size() // 2 + 1
+
+    def majority_reject(self, rejected: Iterable[RaftPeerId]) -> bool:
+        rej = set(rejected)
+        count = sum(1 for p in self.peers if p.id in rej)
+        return self.size() > 0 and count >= (self.size() + 1) // 2
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftConfiguration:
+    conf: PeerConfiguration
+    old_conf: Optional[PeerConfiguration] = None  # set during joint consensus
+    log_index: int = 0
+
+    @staticmethod
+    def from_peers(peers: Iterable[RaftPeer], log_index: int = 0) -> "RaftConfiguration":
+        voting, listeners = [], []
+        for p in peers:
+            (listeners if p.is_listener() else voting).append(p)
+        return RaftConfiguration(PeerConfiguration(tuple(voting), tuple(listeners)),
+                                 None, log_index)
+
+    @staticmethod
+    def from_entry(entry: LogEntry) -> "RaftConfiguration":
+        c: ConfigurationEntry = entry.conf
+        old = None
+        if c.old_peers or c.old_listeners:
+            old = PeerConfiguration(tuple(c.old_peers), tuple(c.old_listeners))
+        return RaftConfiguration(PeerConfiguration(tuple(c.peers), tuple(c.listeners)),
+                                 old, entry.index)
+
+    def to_entry(self, term: int, index: int) -> LogEntry:
+        return make_config_entry(
+            term, index, self.conf.peers,
+            old_peers=self.old_conf.peers if self.old_conf else (),
+            listeners=self.conf.listeners,
+            old_listeners=self.old_conf.listeners if self.old_conf else ())
+
+    # -- membership queries --------------------------------------------------
+
+    def is_transitional(self) -> bool:
+        return self.old_conf is not None
+
+    def is_stable(self) -> bool:
+        return self.old_conf is None
+
+    def contains_voting(self, peer_id: RaftPeerId) -> bool:
+        ok = self.conf.contains(peer_id)
+        if self.old_conf is not None:
+            return ok or self.old_conf.contains(peer_id)
+        return ok
+
+    def contains_current(self, peer_id: RaftPeerId) -> bool:
+        return self.conf.contains(peer_id)
+
+    def is_single_mode(self, peer_id: RaftPeerId) -> bool:
+        """Candidate is the only voting member (LeaderElection singleMode)."""
+        return (self.is_stable() and self.conf.size() == 1
+                and self.conf.contains(peer_id))
+
+    def get_peer(self, peer_id: RaftPeerId) -> Optional[RaftPeer]:
+        p = self.conf.get(peer_id)
+        if p is None and self.old_conf is not None:
+            p = self.old_conf.get(peer_id)
+        return p
+
+    def all_peers(self) -> tuple[RaftPeer, ...]:
+        """Every distinct member (voting + listener, both confs)."""
+        seen: dict[RaftPeerId, RaftPeer] = {}
+        for conf in filter(None, (self.conf, self.old_conf)):
+            for p in (*conf.peers, *conf.listeners):
+                seen.setdefault(p.id, p)
+        return tuple(seen.values())
+
+    def voting_peers(self) -> tuple[RaftPeer, ...]:
+        seen: dict[RaftPeerId, RaftPeer] = {}
+        for conf in filter(None, (self.conf, self.old_conf)):
+            for p in conf.peers:
+                seen.setdefault(p.id, p)
+        return tuple(seen.values())
+
+    def other_peers(self, self_id: RaftPeerId) -> tuple[RaftPeer, ...]:
+        return tuple(p for p in self.all_peers() if p.id != self_id)
+
+    def has_majority(self, voted: Iterable[RaftPeerId]) -> bool:
+        voted = list(voted)
+        ok = self.conf.has_majority(voted)
+        if self.old_conf is not None:
+            return ok and self.old_conf.has_majority(voted)
+        return ok
+
+    def majority_reject(self, rejected: Iterable[RaftPeerId]) -> bool:
+        rejected = list(rejected)
+        if self.conf.majority_reject(rejected):
+            return True
+        return self.old_conf is not None and self.old_conf.majority_reject(rejected)
+
+    def __str__(self) -> str:
+        s = f"conf@{self.log_index}:{[str(p) for p in self.conf.peers]}"
+        if self.old_conf is not None:
+            s += f", old:{[str(p) for p in self.old_conf.peers]}"
+        return s

@@ -1,0 +1,637 @@
+"""Division: one group member — role machine, RPC handlers, apply loop.
+
+Capability parity with the reference RaftServerImpl
+(ratis-server/.../impl/RaftServerImpl.java:155): role transitions
+(changeToFollower:587 / changeToLeader:635 / changeToCandidate:706), the
+client write path (submitClientRequestAsync:937 -> appendTransaction:820),
+reads (readAsync:1058, staleReadAsync:1024), the follower side
+(requestVote:1420, appendEntriesAsync:1489 with the inconsistency check
+:1661), apply (applyLogToStateMachine:1850 via StateMachineUpdater), and
+leader-election wiring.
+
+Structural difference by design: no per-division threads.  Election timeout
+detection and commit advancement live in the server-wide QuorumEngine; the
+division implements the EngineListener callbacks.  Only transient activities
+(an in-flight election, per-follower appenders while leader, the apply loop)
+are asyncio tasks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Optional
+
+import numpy as np
+
+from ratis_tpu.conf.keys import RaftServerConfigKeys
+from ratis_tpu.engine.state import (ROLE_CANDIDATE, ROLE_FOLLOWER,
+                                    ROLE_LEADER, ROLE_LISTENER)
+from ratis_tpu.protocol.exceptions import (LeaderNotReadyException,
+                                           LeaderSteppingDownException,
+                                           NotLeaderException, RaftException,
+                                           StaleReadException,
+                                           StateMachineException)
+from ratis_tpu.protocol.group import RaftGroup, RaftGroupMemberId
+from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.logentry import (LogEntry, LogEntryKind,
+                                         make_transaction_entry)
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.peer import RaftPeer, RaftPeerRole
+from ratis_tpu.protocol.raftrpc import (AppendEntriesReply,
+                                        AppendEntriesRequest, AppendResult,
+                                        RaftRpcHeader, RequestVoteReply,
+                                        RequestVoteRequest)
+from ratis_tpu.protocol.requests import (RaftClientReply, RaftClientRequest,
+                                         RequestType)
+from ratis_tpu.protocol.termindex import INVALID_LOG_INDEX, TermIndex
+from ratis_tpu.server.config import RaftConfiguration
+from ratis_tpu.server.election import LeaderElection
+from ratis_tpu.server.leader import FollowerInfo, LeaderContext
+from ratis_tpu.server.state import ServerState
+from ratis_tpu.server.statemachine import StateMachine, TransactionContext
+from ratis_tpu.util import injection
+
+LOG = logging.getLogger(__name__)
+
+
+class Division:
+    def __init__(self, server, group: RaftGroup, state_machine: StateMachine,
+                 log=None):
+        self.server = server
+        self.group_id: RaftGroupId = group.group_id
+        self.member_id = RaftGroupMemberId(server.peer_id, group.group_id)
+        self.state = ServerState(self.member_id, group, log=log)
+        self.state_machine = state_machine
+        state_machine.member_id = self.member_id
+
+        me = group.get_peer(server.peer_id)
+        self.role: RaftPeerRole = (RaftPeerRole.LISTENER
+                                   if me is not None and me.is_listener()
+                                   else RaftPeerRole.FOLLOWER)
+        self.leader_ctx: Optional[LeaderContext] = None
+        self.election: Optional[LeaderElection] = None
+        self._election_task: Optional[asyncio.Task] = None
+
+        p = server.properties
+        self._timeout_min_s = RaftServerConfigKeys.Rpc.timeout_min(p).seconds
+        self._timeout_max_s = RaftServerConfigKeys.Rpc.timeout_max(p).seconds
+        self.pre_vote_enabled = RaftServerConfigKeys.LeaderElection.pre_vote(p)
+
+        # engine wiring
+        self.engine_slot: int = -1
+        self.peer_slots: dict[RaftPeerId, int] = {}
+        self.max_peers: int = server.engine.state.max_peers
+
+        # apply loop
+        self._applied_index = -1
+        self._apply_wake = asyncio.Event()
+        self._apply_task: Optional[asyncio.Task] = None
+        self._running = False
+        self._rng = random.Random(hash((str(self.member_id),)) & 0xFFFFFFFF)
+        self._last_heard_leader_s = 0.0
+
+    # ------------------------------------------------------------------ util
+
+    def is_leader(self) -> bool:
+        return self.role == RaftPeerRole.LEADER
+
+    def is_follower(self) -> bool:
+        return self.role == RaftPeerRole.FOLLOWER
+
+    def is_candidate(self) -> bool:
+        return self.role == RaftPeerRole.CANDIDATE
+
+    def is_listener(self) -> bool:
+        return self.role == RaftPeerRole.LISTENER
+
+    @property
+    def applied_index(self) -> int:
+        return self._applied_index
+
+    def random_election_timeout_s(self) -> float:
+        return self._rng.uniform(self._timeout_min_s, self._timeout_max_s)
+
+    def get_leader_peer(self) -> Optional[RaftPeer]:
+        lid = self.state.leader_id
+        if lid is None:
+            return None
+        return self.state.configuration.get_peer(lid)
+
+    # -------------------------------------------------------- engine wiring
+
+    def attach_engine(self) -> None:
+        engine = self.server.engine
+        self.engine_slot = engine.attach(self)
+        self._assign_peer_slots()
+        self._sync_conf_to_engine()
+        engine.state.role[self.engine_slot] = (
+            ROLE_LISTENER if self.is_listener() else ROLE_FOLLOWER)
+        if not self.is_listener():
+            self.reset_election_deadline()
+
+    def detach_engine(self) -> None:
+        if self.engine_slot >= 0:
+            self.server.engine.detach(self.engine_slot)
+            self.engine_slot = -1
+
+    def _assign_peer_slots(self) -> None:
+        """Stable peer->column mapping for the [G, P] arrays.  Existing
+        assignments survive conf changes; new peers take free columns."""
+        for peer in sorted(self.state.configuration.all_peers(),
+                           key=lambda p: p.id.id):
+            if peer.id not in self.peer_slots:
+                used = set(self.peer_slots.values())
+                free = next(i for i in range(self.max_peers) if i not in used)
+                self.peer_slots[peer.id] = free
+        if self.member_id.peer_id not in self.peer_slots:
+            used = set(self.peer_slots.values())
+            free = next(i for i in range(self.max_peers) if i not in used)
+            self.peer_slots[self.member_id.peer_id] = free
+
+    def _sync_conf_to_engine(self) -> None:
+        import numpy as np
+        conf = self.state.configuration
+        n = self.max_peers
+        cur = np.zeros(n, bool)
+        old = np.zeros(n, bool)
+        prio = np.zeros(n, np.int32)
+        for p in conf.conf.peers:
+            s = self.peer_slots.get(p.id)
+            if s is not None:
+                cur[s] = True
+                prio[s] = p.priority
+        if conf.old_conf is not None:
+            for p in conf.old_conf.peers:
+                s = self.peer_slots.get(p.id)
+                if s is not None:
+                    old[s] = True
+                    prio[s] = p.priority
+        me = self.peer_slots[self.member_id.peer_id]
+        my_peer = conf.get_peer(self.member_id.peer_id)
+        self.server.engine.state.set_conf(
+            self.engine_slot, me, cur, old, prio,
+            my_peer.priority if my_peer is not None else 0)
+
+    def reset_election_deadline(self) -> None:
+        if self.engine_slot < 0 or self.is_listener():
+            return
+        engine = self.server.engine
+        deadline = engine.clock.now_ms() + int(self.random_election_timeout_s() * 1000)
+        engine.state.election_deadline_ms[self.engine_slot] = deadline
+
+    def _engine_set_role(self, role_code: int) -> None:
+        if self.engine_slot >= 0:
+            self.server.engine.state.role[self.engine_slot] = role_code
+
+    def _engine_update_flush(self) -> None:
+        if self.engine_slot >= 0:
+            st = self.server.engine.state
+            st.flush_index[self.engine_slot] = self.state.log.flush_index
+            self.server.engine.notify()
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._running = True
+        await self.state.log.open()
+        self.attach_engine()
+        self._apply_task = asyncio.create_task(
+            self._apply_loop(), name=f"applier-{self.member_id}")
+
+    async def close(self) -> None:
+        self._running = False
+        if self.election is not None:
+            self.election.stop()
+        if self._election_task is not None:
+            self._election_task.cancel()
+        if self.leader_ctx is not None:
+            await self.leader_ctx.stop()
+            self.leader_ctx = None
+        if self._apply_task is not None:
+            self._apply_task.cancel()
+            try:
+                await self._apply_task
+            except asyncio.CancelledError:
+                pass
+        self.detach_engine()
+        await self.state.log.close()
+        await self.state_machine.close()
+
+    # -------------------------------------------------- EngineListener API
+
+    async def on_election_timeout(self) -> None:
+        if not self._running or not self.is_follower():
+            return
+        if not self.state.configuration.contains_voting(self.member_id.peer_id):
+            self.reset_election_deadline()
+            return
+        await self.change_to_candidate()
+
+    async def on_commit_advance(self, new_commit: int) -> None:
+        """Engine advanced this group's commit (leader only)."""
+        if not self.is_leader():
+            return
+        self.state.log.update_commit_index(new_commit,
+                                           self.state.current_term, True)
+        self._apply_wake.set()
+        # watch/lease hooks come in later milestones
+
+    async def on_leadership_stale(self) -> None:
+        if self.is_leader():
+            await self.change_to_follower(
+                self.state.current_term, None,
+                reason="no majority ack within leadership timeout")
+
+    # ----------------------------------------------------- role transitions
+
+    async def change_to_candidate(self, force: bool = False) -> None:
+        assert self.is_follower()
+        self.role = RaftPeerRole.CANDIDATE
+        self._engine_set_role(ROLE_CANDIDATE)
+        self.election = LeaderElection(self, force=force)
+
+        async def _run_and_rearm():
+            try:
+                await self.election.run()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                LOG.exception("%s election failed", self.member_id)
+            finally:
+                if self.is_candidate():
+                    # election did not conclude in leadership: back to follower
+                    self.role = RaftPeerRole.FOLLOWER
+                    self._engine_set_role(ROLE_FOLLOWER)
+                    self.reset_election_deadline()
+
+        self._election_task = asyncio.create_task(
+            _run_and_rearm(), name=f"election-{self.member_id}")
+
+    async def change_to_leader(self) -> None:
+        assert self.is_candidate()
+        self.role = RaftPeerRole.LEADER
+        self.state.set_leader(self.member_id.peer_id)
+        self._engine_set_role(ROLE_LEADER)
+        st = self.server.engine.state
+        st.election_deadline_ms[self.engine_slot] = np.iinfo(np.int32).max
+        now = self.server.engine.clock.now_ms()
+        st.last_ack_ms[self.engine_slot, :] = now
+        st.match_index[self.engine_slot, :] = -1
+
+        self.leader_ctx = LeaderContext(self)
+        # Append the startup placeholder entry carrying the current conf
+        # (reference appends a conf/StartupLogEntry on election,
+        # LeaderStateImpl.java:293): commits of earlier-term entries are
+        # gated on this index (Raft §5.4.2).
+        conf = self.state.configuration
+        index = self.state.log.next_index
+        entry = conf.to_entry(self.state.current_term, index)
+        self.leader_ctx.startup_index = index
+        st.first_leader_index[self.engine_slot] = index
+        await self.state.log.append_entry(entry)
+        self.state.apply_log_entry_configuration(entry)
+        self._engine_update_flush()
+        self.leader_ctx.start_appenders()
+        LOG.info("%s became LEADER at term %d", self.member_id,
+                 self.state.current_term)
+
+    async def change_to_follower(self, term: int, leader_id: Optional[RaftPeerId],
+                                 reason: str = "") -> None:
+        old_role = self.role
+        if self.is_listener():
+            await self.state.update_current_term(term)
+            if leader_id is not None:
+                self.state.set_leader(leader_id)
+            return
+        self.role = RaftPeerRole.FOLLOWER
+        self._engine_set_role(ROLE_FOLLOWER)
+        await self.state.update_current_term(term)
+        if leader_id is not None:
+            changed = self.state.set_leader(leader_id)
+            if changed:
+                await self.state_machine.notify_leader_changed(
+                    self.member_id, leader_id)
+        if old_role == RaftPeerRole.LEADER and self.leader_ctx is not None:
+            ctx = self.leader_ctx
+            self.leader_ctx = None
+            await ctx.stop(NotLeaderException(
+                self.member_id, self.get_leader_peer(),
+                self.state.configuration.all_peers()))
+            LOG.info("%s stepped down (%s)", self.member_id, reason)
+        if old_role == RaftPeerRole.CANDIDATE and self.election is not None:
+            self.election.stop()
+        self.reset_election_deadline()
+
+    # ------------------------------------------------------- follower RPCs
+
+    async def handle_request_vote(self, req: RequestVoteRequest) -> RequestVoteReply:
+        await injection.execute(injection.REQUEST_VOTE, self.member_id,
+                                req.header.requestor_id)
+        state = self.state
+        header = RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
+                               self.group_id)
+        my_last = state.log.get_last_entry_term_index() or TermIndex.INITIAL_VALUE
+
+        def reply(granted: bool, term: int) -> RequestVoteReply:
+            return RequestVoteReply(header, term, granted, last_entry=my_last)
+
+        candidate = req.header.requestor_id
+        # Listener never votes (quorum exclusion).
+        if self.is_listener():
+            return reply(False, state.current_term)
+
+        if req.candidate_term < state.current_term:
+            return reply(False, state.current_term)
+
+        # Leader stickiness: deny if we recently heard from a live leader
+        # (reference VoteContext lease check) — applies to both phases.
+        loop_now = asyncio.get_event_loop().time()
+        has_live_leader = (state.leader_id is not None
+                           and state.leader_id != candidate
+                           and (loop_now - self._last_heard_leader_s)
+                           < self._timeout_min_s)
+        if has_live_leader:
+            return reply(False, state.current_term)
+
+        if req.pre_vote:
+            # no term/vote changes; just report whether we WOULD vote
+            ok = state.is_log_up_to_date(req.candidate_last_entry)
+            return reply(ok, state.current_term)
+
+        if req.candidate_term > state.current_term:
+            await self.change_to_follower(req.candidate_term, None,
+                                          reason="higher term in vote request")
+
+        granted = False
+        if (state.voted_for is None or state.voted_for == candidate) \
+                and state.is_log_up_to_date(req.candidate_last_entry):
+            await state.grant_vote(candidate)
+            self.reset_election_deadline()
+            granted = True
+        return reply(granted, state.current_term)
+
+    async def handle_append_entries(self, req: AppendEntriesRequest
+                                    ) -> AppendEntriesReply:
+        await injection.execute(injection.APPEND_ENTRIES, self.member_id,
+                                req.header.requestor_id)
+        state = self.state
+        log = state.log
+        header = RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
+                               self.group_id)
+
+        def reply(result: AppendResult, next_index: int) -> AppendEntriesReply:
+            return AppendEntriesReply(
+                header, state.current_term, result, next_index,
+                log.get_last_committed_index(), log.flush_index,
+                is_heartbeat=req.is_heartbeat())
+
+        if req.leader_term < state.current_term:
+            return reply(AppendResult.NOT_LEADER, log.next_index)
+
+        # Recognize the leader: higher-or-equal term append wins.
+        if req.leader_term > state.current_term or not self.is_follower() \
+                or state.leader_id != req.header.requestor_id:
+            await self.change_to_follower(req.leader_term,
+                                          req.header.requestor_id,
+                                          reason="append from leader")
+        self._last_heard_leader_s = asyncio.get_event_loop().time()
+        self.reset_election_deadline()
+
+        # Inconsistency check (checkInconsistentAppendEntries:1661).
+        if req.previous is not None:
+            ti = log.get_term_index(req.previous.index)
+            if ti is None and self._snapshot_matches(req.previous):
+                ti = req.previous
+            if ti is None or ti.term != req.previous.term:
+                hint = min(log.next_index, req.previous.index)
+                return reply(AppendResult.INCONSISTENCY, max(hint, log.start_index))
+
+        if req.entries:
+            old_next = log.next_index
+            await log.append_entries_follower(req.entries)
+            if log.next_index < old_next:
+                state.truncate_configurations(log.next_index)
+            for e in req.entries:
+                if e.is_config():
+                    state.apply_log_entry_configuration(e)
+                    self._sync_conf_to_engine()
+            self._engine_update_flush()
+
+        # Follower commit: min(leaderCommit, last local index).
+        commit = min(req.leader_commit, log.flush_index)
+        if log.update_commit_index(commit, state.current_term, False):
+            self._apply_wake.set()
+
+        return reply(AppendResult.SUCCESS, log.next_index)
+
+    async def handle_install_snapshot(self, req):
+        """Chunked/notification snapshot install — snapshot milestone."""
+        from ratis_tpu.protocol.raftrpc import (InstallSnapshotReply,
+                                                InstallSnapshotResult)
+        return InstallSnapshotReply(
+            RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
+                          self.group_id),
+            self.state.current_term, InstallSnapshotResult.NOT_LEADER)
+
+    async def handle_read_index(self, req):
+        """Leader-side readIndex for follower-serving reads — read milestone."""
+        from ratis_tpu.protocol.raftrpc import ReadIndexReply
+        header = RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
+                               self.group_id)
+        if not self.is_leader():
+            return ReadIndexReply(header, False)
+        return ReadIndexReply(header, True,
+                              self.state.log.get_last_committed_index())
+
+    async def handle_start_leader_election(self, req):
+        """Transfer-leadership target: start an immediate (forced) election
+        (reference RaftServerImpl.startLeaderElection:1735)."""
+        from ratis_tpu.protocol.raftrpc import StartLeaderElectionReply
+        header = RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
+                               self.group_id)
+        my_last = self.state.log.get_last_entry_term_index() \
+            or TermIndex.INITIAL_VALUE
+        if not self.is_follower() or my_last < req.leader_last_entry:
+            return StartLeaderElectionReply(header, False)
+        await self.change_to_candidate(force=True)
+        return StartLeaderElectionReply(header, True)
+
+    def _snapshot_matches(self, ti: TermIndex) -> bool:
+        snap = self.state_machine.get_latest_snapshot()
+        return snap is not None and snap.term_index == ti
+
+    def snapshot_covers(self, index: int) -> bool:
+        snap = self.state_machine.get_latest_snapshot()
+        return snap is not None and snap.index >= index
+
+    def snapshot_term_index(self, index: int) -> Optional[TermIndex]:
+        snap = self.state_machine.get_latest_snapshot()
+        if snap is not None and snap.index == index:
+            return snap.term_index
+        return None
+
+    async def try_install_snapshot(self, follower: FollowerInfo) -> bool:
+        """Follower is behind the purged log; snapshot install comes with the
+        snapshot milestone."""
+        return False
+
+    # --------------------------------------------------------- leader acks
+
+    def on_follower_ack(self, follower: FollowerInfo) -> None:
+        slot = self.peer_slots.get(follower.peer_id)
+        if slot is not None and self.engine_slot >= 0:
+            self.server.engine.on_ack(self.engine_slot, slot,
+                                      follower.match_index)
+
+    def on_follower_heartbeat_ack(self, follower: FollowerInfo) -> None:
+        slot = self.peer_slots.get(follower.peer_id)
+        if slot is not None and self.engine_slot >= 0:
+            st = self.server.engine.state
+            now = self.server.engine.clock.now_ms()
+            if st.last_ack_ms[self.engine_slot, slot] < now:
+                st.last_ack_ms[self.engine_slot, slot] = now
+
+    # ------------------------------------------------------- client path
+
+    async def submit_client_request(self, req: RaftClientRequest) -> RaftClientReply:
+        t = req.type.type
+        if t == RequestType.WRITE:
+            return await self._write_async(req)
+        if t == RequestType.READ:
+            return await self._read_async(req)
+        if t == RequestType.STALE_READ:
+            return await self._stale_read_async(req)
+        return RaftClientReply.failure_reply(
+            req, RaftException(f"unsupported request type {t.name}"))
+
+    def _check_leader(self, req: RaftClientRequest) -> Optional[RaftClientReply]:
+        if not self.is_leader() or self.leader_ctx is None:
+            return RaftClientReply.failure_reply(
+                req, NotLeaderException(self.member_id, self.get_leader_peer(),
+                                        self.state.configuration.all_peers()))
+        if not self.leader_ctx.leader_ready.done():
+            # Leader until the startup entry commits: retryable not-ready.
+            if self._applied_index < self.leader_ctx.startup_index:
+                return RaftClientReply.failure_reply(
+                    req, LeaderNotReadyException(self.member_id))
+        return None
+
+    async def _write_async(self, req: RaftClientRequest) -> RaftClientReply:
+        err = self._check_leader(req)
+        if err is not None:
+            return err
+        await injection.execute(injection.APPEND_TRANSACTION, self.member_id,
+                                req.client_id)
+        try:
+            trx = await self.state_machine.start_transaction(req)
+        except Exception as e:
+            return RaftClientReply.failure_reply(
+                req, StateMachineException(str(e), cause=e))
+        if trx.exception is not None:
+            return RaftClientReply.failure_reply(
+                req, StateMachineException(str(trx.exception),
+                                           cause=trx.exception))
+        trx = await self.state_machine.pre_append_transaction(trx)
+
+        log = self.state.log
+        index = log.next_index
+        entry = make_transaction_entry(self.state.current_term, index,
+                                       req.client_id, req.call_id,
+                                       trx.log_data or b"",
+                                       sm_data=trx.sm_data)
+        trx.log_entry = entry
+        self.server.transactions[(self.group_id, index)] = trx
+        try:
+            pending = self.leader_ctx.pending.add(index, req)
+        except RaftException as e:
+            return RaftClientReply.failure_reply(req, e)
+        await log.append_entry(entry)
+        self._engine_update_flush()
+        self.leader_ctx.notify_appenders()
+        return await pending.future
+
+    async def _read_async(self, req: RaftClientRequest) -> RaftClientReply:
+        err = self._check_leader(req)
+        if err is not None:
+            return err
+        try:
+            result = await self.state_machine.query(req.message)
+        except Exception as e:
+            return RaftClientReply.failure_reply(
+                req, StateMachineException(str(e), cause=e))
+        return RaftClientReply.success_reply(req, message=result,
+                                             log_index=self._applied_index)
+
+    async def _stale_read_async(self, req: RaftClientRequest) -> RaftClientReply:
+        min_index = req.type.stale_read_min_index
+        if self._applied_index < min_index:
+            return RaftClientReply.failure_reply(
+                req, StaleReadException(
+                    f"applied index {self._applied_index} < requested {min_index}"))
+        try:
+            result = await self.state_machine.query_stale(req.message, min_index)
+        except Exception as e:
+            return RaftClientReply.failure_reply(
+                req, StateMachineException(str(e), cause=e))
+        return RaftClientReply.success_reply(req, message=result,
+                                             log_index=self._applied_index)
+
+    # ----------------------------------------------------------- apply loop
+
+    async def _apply_loop(self) -> None:
+        """StateMachineUpdater (reference StateMachineUpdater.java:60): waits
+        for the commit index to advance, applies entries in order, completes
+        pending client futures."""
+        sm = self.state_machine
+        while self._running:
+            log = self.state.log
+            if self._applied_index >= log.get_last_committed_index():
+                self._apply_wake.clear()
+                try:
+                    await asyncio.wait_for(self._apply_wake.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    continue
+            committed = log.get_last_committed_index()
+            while self._applied_index < committed:
+                index = self._applied_index + 1
+                entry = log.get(index)
+                if entry is None:
+                    break  # purged or not yet local (snapshot install)
+                await self._apply_one(entry)
+                self._applied_index = index
+                sm.update_last_applied_term_index(entry.term, entry.index)
+            if self.is_leader() and self.leader_ctx is not None \
+                    and not self.leader_ctx.leader_ready.done() \
+                    and self._applied_index >= self.leader_ctx.startup_index >= 0:
+                self.leader_ctx.leader_ready.set_result(True)
+                await sm.notify_leader_ready()
+
+    async def _apply_one(self, entry: LogEntry) -> None:
+        sm = self.state_machine
+        reply_message: Optional[Message] = None
+        exception: Optional[Exception] = None
+        if entry.kind == LogEntryKind.STATE_MACHINE:
+            trx = self.server.transactions.pop((self.group_id, entry.index), None)
+            if trx is None or trx.log_entry is None \
+                    or trx.log_entry.term_index() != entry.term_index():
+                trx = TransactionContext(log_entry=entry)
+            try:
+                reply_message = await sm.apply_transaction(trx)
+            except Exception as e:
+                exception = StateMachineException(str(e), cause=e)
+        elif entry.kind == LogEntryKind.CONFIGURATION:
+            await sm.notify_configuration_changed(
+                entry.term, entry.index, self.state.configuration)
+        await sm.notify_term_index_updated(entry.term, entry.index)
+
+        if self.is_leader() and self.leader_ctx is not None:
+            pending = self.leader_ctx.pending.pop(entry.index)
+            if pending is not None:
+                if exception is not None:
+                    pending.fail(exception)
+                else:
+                    pending.set_reply(RaftClientReply.success_reply(
+                        pending.request, message=reply_message or Message.EMPTY,
+                        log_index=entry.index))

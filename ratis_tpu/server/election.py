@@ -1,0 +1,191 @@
+"""Leader election driver (candidate side).
+
+Capability parity with the reference LeaderElection
+(ratis-server/.../impl/LeaderElection.java:80): rounds of PRE_VOTE then
+ELECTION (runImpl:365-379), parallel vote requests (submitRequests:477),
+incremental tallying with priority vetoes and the higher-priority-replied
+gate (waitForResults:498-592), early exit on discovered terms, and the
+single-mode pass.
+
+The tally math is :mod:`ratis_tpu.ops.reference` — the same algorithm the
+batched kernel runs for election storms; one division electing uses the
+scalar form directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import random
+from typing import Optional
+
+from ratis_tpu.ops import reference as ref
+from ratis_tpu.protocol.raftrpc import (RaftRpcHeader, RequestVoteReply,
+                                        RequestVoteRequest)
+from ratis_tpu.protocol.termindex import TermIndex
+
+LOG = logging.getLogger(__name__)
+
+
+class Phase(enum.Enum):
+    PRE_VOTE = "PRE_VOTE"
+    ELECTION = "ELECTION"
+
+
+class Result(enum.Enum):
+    PASSED = "PASSED"
+    SINGLE_MODE_PASSED = "SINGLE_MODE_PASSED"
+    REJECTED = "REJECTED"
+    TIMEOUT = "TIMEOUT"
+    DISCOVERED_A_NEW_TERM = "DISCOVERED_A_NEW_TERM"
+    SHUTDOWN = "SHUTDOWN"
+    NOT_IN_CONF = "NOT_IN_CONF"
+
+
+class LeaderElection:
+    def __init__(self, division, force: bool = False):
+        self.division = division
+        self.force = force  # transfer-leadership skips PRE_VOTE
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    async def run(self) -> None:
+        """One full attempt: optional PRE_VOTE, then ELECTION; on success the
+        division becomes leader, otherwise the election deadline re-arms."""
+        div = self.division
+        conf = div.state.configuration
+        if not conf.contains_voting(div.member_id.peer_id):
+            LOG.debug("%s not in conf, skip election", div.member_id)
+            div.reset_election_deadline()
+            return
+
+        if div.pre_vote_enabled and not self.force:
+            result, _ = await self._ask_for_votes(Phase.PRE_VOTE)
+            if result == Result.DISCOVERED_A_NEW_TERM:
+                return  # change_to_follower already happened
+            if result not in (Result.PASSED, Result.SINGLE_MODE_PASSED):
+                div.reset_election_deadline()
+                return
+        if self._stopped or not div.is_candidate():
+            return
+
+        result, term = await self._ask_for_votes(Phase.ELECTION)
+        if self._stopped or not div.is_candidate():
+            return
+        if result in (Result.PASSED, Result.SINGLE_MODE_PASSED):
+            await div.change_to_leader()
+        elif result == Result.DISCOVERED_A_NEW_TERM:
+            pass  # handled inline
+        else:
+            await div.change_to_follower(div.state.current_term, None,
+                                         reason=f"election {result.value}")
+
+    async def _ask_for_votes(self, phase: Phase) -> tuple[Result, int]:
+        div = self.division
+        conf = div.state.configuration
+        state = div.state
+
+        if phase == Phase.ELECTION:
+            term = await state.init_election_term()
+        else:
+            term = state.current_term + 1  # probe term, nothing persisted
+
+        last = state.log.get_last_entry_term_index() or TermIndex.INITIAL_VALUE
+        others = [p for p in conf.voting_peers() if p.id != div.member_id.peer_id]
+
+        if conf.is_single_mode(div.member_id.peer_id) or not others:
+            return Result.PASSED, term
+
+        # slot-indexed tallies for ops.reference.tally_votes
+        slots = div.peer_slots
+        n = div.max_peers
+        grants = [False] * n
+        rejects = [False] * n
+        priority = [0] * n
+        conf_cur = [False] * n
+        conf_old = [False] * n
+        for p in conf.conf.peers:
+            s = slots.get(p.id)
+            if s is not None:
+                conf_cur[s] = True
+                priority[s] = p.priority
+        if conf.old_conf is not None:
+            for p in conf.old_conf.peers:
+                s = slots.get(p.id)
+                if s is not None:
+                    conf_old[s] = True
+                    priority[s] = p.priority
+        me = div.peer_slots[div.member_id.peer_id]
+        grants[me] = True
+        self_priority = (conf.get_peer(div.member_id.peer_id).priority
+                         if conf.get_peer(div.member_id.peer_id) else 0)
+
+        header = lambda to: RaftRpcHeader(div.member_id.peer_id, to.id,
+                                          div.group_id)
+        request = lambda to: RequestVoteRequest(
+            header(to), term, last, pre_vote=(phase == Phase.PRE_VOTE))
+
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def _one(peer):
+            try:
+                reply = await div.server.send_server_rpc(peer.id, request(peer))
+                await queue.put(reply)
+            except Exception as e:
+                await queue.put(e)
+
+        tasks = [asyncio.create_task(_one(p)) for p in others]
+        deadline = asyncio.get_event_loop().time() + div.random_election_timeout_s()
+        outstanding = len(others)
+        replied: set = set()
+        try:
+            while outstanding > 0 and not self._stopped:
+                wait = deadline - asyncio.get_event_loop().time()
+                if wait <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(queue.get(), wait)
+                except asyncio.TimeoutError:
+                    break
+                outstanding -= 1
+                if isinstance(item, Exception):
+                    continue
+                reply: RequestVoteReply = item
+                peer_id = reply.header.requestor_id
+                if peer_id in replied:
+                    continue
+                replied.add(peer_id)
+                if reply.should_shutdown:
+                    return Result.SHUTDOWN, term
+                if reply.term > term:
+                    await div.change_to_follower(
+                        reply.term, None, reason="higher term in vote reply")
+                    return Result.DISCOVERED_A_NEW_TERM, reply.term
+                s = slots.get(peer_id)
+                if s is None:
+                    continue
+                if reply.granted:
+                    grants[s] = True
+                else:
+                    rejects[s] = True
+                passed, _, rejected = ref.tally_votes(
+                    grants, rejects, conf_cur, conf_old, priority, self_priority)
+                if passed:
+                    return Result.PASSED, term
+                if rejected:
+                    return Result.REJECTED, term
+        finally:
+            for t in tasks:
+                t.cancel()
+
+        # deadline or all replies in: the timeout-path tally
+        _, passed_on_timeout, rejected = ref.tally_votes(
+            grants, rejects, conf_cur, conf_old, priority, self_priority)
+        if passed_on_timeout:
+            return Result.PASSED, term
+        if conf.is_single_mode(div.member_id.peer_id):
+            return Result.SINGLE_MODE_PASSED, term
+        return (Result.REJECTED if rejected else Result.TIMEOUT), term

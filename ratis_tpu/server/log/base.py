@@ -1,0 +1,154 @@
+"""RaftLog API and shared base behavior.
+
+Capability parity with the reference RaftLog SPI
+(ratis-server-api/.../server/raftlog/RaftLog.java:38 — commit tracking,
+updateCommitIndex:114, purge:132) and RaftLogBase
+(ratis-server/.../raftlog/RaftLogBase.java — append validation, the
+truncate-and-append conflict resolution used by followers, open/close).
+
+asyncio-native: ``append_entry`` returns once the entry is durable (flushed);
+``flush_index`` feeds the leader's own slot in the batched commit kernel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable, Optional, Sequence
+
+from ratis_tpu.protocol.exceptions import LogCorruptedException, RaftException
+from ratis_tpu.protocol.logentry import LogEntry
+from ratis_tpu.protocol.termindex import INVALID_LOG_INDEX, TermIndex
+
+LEAST_VALID_LOG_INDEX = 0
+
+
+class RaftLog:
+    """Abstract log of one division."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._commit_index = INVALID_LOG_INDEX
+        self._purge_index = INVALID_LOG_INDEX
+        self._open = False
+
+    # -- open/close ----------------------------------------------------------
+
+    async def open(self, last_index_on_snapshot: int = INVALID_LOG_INDEX) -> None:
+        self._open = True
+
+    async def close(self) -> None:
+        self._open = False
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+    # -- indices -------------------------------------------------------------
+
+    @property
+    def commit_index(self) -> int:
+        return self._commit_index
+
+    def get_last_committed_index(self) -> int:
+        return self._commit_index
+
+    def update_commit_index(self, majority_index: int, current_term: int,
+                            is_leader: bool) -> bool:
+        """Advance commitIndex monotonically (RaftLog.updateCommitIndex:114).
+        Leader-side term gating already happened in the quorum kernel; the
+        follower side passes the leader's commit directly."""
+        if majority_index <= self._commit_index:
+            return False
+        if is_leader:
+            ti = self.get_term_index(majority_index)
+            if ti is None or ti.term != current_term:
+                return False
+        self._commit_index = majority_index
+        return True
+
+    @property
+    def start_index(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def next_index(self) -> int:
+        ti = self.get_last_entry_term_index()
+        return (ti.index + 1) if ti is not None else max(self.start_index, 0)
+
+    @property
+    def flush_index(self) -> int:
+        raise NotImplementedError
+
+    def get_last_entry_term_index(self) -> Optional[TermIndex]:
+        raise NotImplementedError
+
+    def get_term_index(self, index: int) -> Optional[TermIndex]:
+        e = self.get(index)
+        return e.term_index() if e is not None else None
+
+    def get(self, index: int) -> Optional[LogEntry]:
+        raise NotImplementedError
+
+    def get_entries(self, start: int, end: int,
+                    max_bytes: int = 1 << 62) -> list[LogEntry]:
+        """Entries in [start, end) bounded by total serialized bytes — the
+        appender batch builder (LogAppenderBase.newAppendEntriesRequest:223).
+        Always returns at least one entry when available."""
+        out: list[LogEntry] = []
+        total = 0
+        for i in range(start, min(end, self.next_index)):
+            e = self.get(i)
+            if e is None:
+                break
+            total += e.serialized_size()
+            if out and total > max_bytes:
+                break
+            out.append(e)
+        return out
+
+    # -- append --------------------------------------------------------------
+
+    async def append_entry(self, entry: LogEntry) -> int:
+        """Append one entry (leader path); resolves when durable."""
+        raise NotImplementedError
+
+    async def append_entries_follower(self, entries: Sequence[LogEntry]) -> int:
+        """Follower path: skip already-present matching entries, truncate at
+        the first term conflict, then append the rest — the reference's
+        truncate-and-append resolution (SegmentedRaftLog.appendEntryImpl:392,
+        truncateImpl:363 and RaftLogBase.appendImpl).  Returns the new last
+        index.  Raises LogCorruptedException when an existing committed entry
+        conflicts."""
+        if not entries:
+            return self.next_index - 1
+        to_append: list[LogEntry] = []
+        truncate_at: Optional[int] = None
+        for e in entries:
+            existing = self.get_term_index(e.index)
+            if existing is None:
+                to_append.append(e)
+            elif existing.term != e.term:
+                if e.index <= self._commit_index:
+                    raise LogCorruptedException(
+                        f"{self.name}: conflict at committed index {e.index}: "
+                        f"existing {existing}, new {e.term_index()}")
+                truncate_at = e.index if truncate_at is None else min(truncate_at, e.index)
+                to_append.append(e)
+            # else: already have it; skip
+        if truncate_at is not None:
+            await self.truncate(truncate_at)
+        for e in to_append:
+            await self.append_entry(e)
+        return self.next_index - 1
+
+    async def truncate(self, index: int) -> None:
+        """Remove entries >= index."""
+        raise NotImplementedError
+
+    async def purge(self, index: int) -> int:
+        """Drop entries <= index (snapshot-covered); returns new start-1."""
+        raise NotImplementedError
+
+    def term_at_or_before(self, index: int) -> Optional[TermIndex]:
+        """TermIndex for a previous-entry check; None if purged away."""
+        return self.get_term_index(index)

@@ -1,0 +1,164 @@
+"""RaftServer: the multi-Raft host (one process, many groups, one endpoint).
+
+Capability parity with the reference RaftServerProxy
+(ratis-server/.../impl/RaftServerProxy.java:81): a map of
+groupId -> Division behind a single transport endpoint, group add/remove
+(groupManagementAsync:490), request routing (getImpl:376), and lifecycle.
+The reference's per-division thread fleet is replaced by the shared
+QuorumEngine tick loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Optional
+
+from ratis_tpu.conf.keys import RaftConfigKeys, RaftServerConfigKeys
+from ratis_tpu.engine.engine import QuorumEngine
+from ratis_tpu.protocol.exceptions import (AlreadyExistsException,
+                                           GroupMismatchException,
+                                           RaftException)
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest,
+                                        InstallSnapshotRequest,
+                                        ReadIndexRequest, RequestVoteRequest,
+                                        StartLeaderElectionRequest)
+from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.protocol.termindex import TermIndex
+from ratis_tpu.server.division import Division
+from ratis_tpu.server.statemachine import StateMachine
+from ratis_tpu.transport.base import ServerTransport, TransportFactory
+from ratis_tpu.util.lifecycle import LifeCycle, LifeCycleState
+
+LOG = logging.getLogger(__name__)
+
+# StateMachine registry: groupId -> StateMachine instance
+StateMachineRegistry = Callable[[RaftGroupId], StateMachine]
+
+
+class RaftServer:
+    def __init__(self, peer_id: RaftPeerId, address: str,
+                 state_machine_registry: StateMachineRegistry,
+                 properties, transport_factory: TransportFactory,
+                 group: Optional[RaftGroup] = None,
+                 log_factory: Optional[Callable] = None):
+        self.peer_id = peer_id
+        self.address = address
+        self.properties = properties
+        self._sm_registry = state_machine_registry
+        self._initial_group = group
+        self._log_factory = log_factory
+        self.life_cycle = LifeCycle(f"server-{peer_id}")
+        self.divisions: dict[RaftGroupId, Division] = {}
+        # Transaction contexts between append and apply
+        # (reference TransactionManager, ratis-server/.../impl/).
+        self.transactions: dict = {}
+
+        p = properties
+        self.engine = QuorumEngine(
+            max_groups=RaftServerConfigKeys.Engine.max_groups(p),
+            max_peers=RaftServerConfigKeys.Engine.max_peers(p),
+            tick_interval_s=RaftServerConfigKeys.Engine.tick_interval(p).seconds,
+            scalar_fallback_threshold=p.get_int(
+                RaftServerConfigKeys.Engine.SCALAR_FALLBACK_THRESHOLD_KEY,
+                RaftServerConfigKeys.Engine.SCALAR_FALLBACK_THRESHOLD_DEFAULT),
+            leadership_timeout_ms=int(
+                RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2))
+        self.transport: ServerTransport = transport_factory.new_server_transport(
+            peer_id, address, self._handle_server_rpc,
+            self._handle_client_request, properties)
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self.life_cycle.transition(LifeCycleState.STARTING)
+        await self.engine.start()
+        if self._initial_group is not None:
+            await self._add_division(self._initial_group)
+        await self.transport.start()
+        self.life_cycle.transition(LifeCycleState.RUNNING)
+
+    async def close(self) -> None:
+        if not self.life_cycle.compare_and_transition(
+                LifeCycleState.RUNNING, LifeCycleState.CLOSING):
+            if not self.life_cycle.compare_and_transition(
+                    LifeCycleState.NEW, LifeCycleState.CLOSING):
+                return
+        await self.transport.close()
+        for div in list(self.divisions.values()):
+            await div.close()
+        self.divisions.clear()
+        await self.engine.close()
+        self.life_cycle.transition(LifeCycleState.CLOSED)
+
+    # -------------------------------------------------------- group mgmt
+
+    async def _add_division(self, group: RaftGroup) -> Division:
+        if group.group_id in self.divisions:
+            raise AlreadyExistsException(f"{self.peer_id} already hosts {group.group_id}")
+        sm = self._sm_registry(group.group_id)
+        log = self._log_factory(self, group) if self._log_factory else None
+        div = Division(self, group, sm, log=log)
+        self.divisions[group.group_id] = div
+        await div.start()
+        return div
+
+    async def group_add(self, group: RaftGroup) -> Division:
+        return await self._add_division(group)
+
+    async def group_remove(self, group_id: RaftGroupId,
+                           delete_directory: bool = False) -> None:
+        div = self.divisions.pop(group_id, None)
+        if div is None:
+            raise GroupMismatchException(f"{self.peer_id} does not host {group_id}")
+        await div.state_machine.notify_group_remove()
+        await div.close()
+
+    def get_division(self, group_id: RaftGroupId) -> Division:
+        div = self.divisions.get(group_id)
+        if div is None:
+            raise GroupMismatchException(
+                f"{self.peer_id} does not serve {group_id}; groups: "
+                f"{[str(g) for g in self.divisions]}")
+        return div
+
+    def group_ids(self) -> list[RaftGroupId]:
+        return list(self.divisions)
+
+    # ------------------------------------------------------------- routing
+
+    async def _handle_server_rpc(self, msg):
+        div = self.get_division(msg.header.group_id)
+        if isinstance(msg, AppendEntriesRequest):
+            return await div.handle_append_entries(msg)
+        if isinstance(msg, RequestVoteRequest):
+            return await div.handle_request_vote(msg)
+        if isinstance(msg, InstallSnapshotRequest):
+            return await div.handle_install_snapshot(msg)
+        if isinstance(msg, ReadIndexRequest):
+            return await div.handle_read_index(msg)
+        if isinstance(msg, StartLeaderElectionRequest):
+            return await div.handle_start_leader_election(msg)
+        raise RaftException(f"unknown server rpc {type(msg).__name__}")
+
+    async def _handle_client_request(self, request: RaftClientRequest
+                                     ) -> RaftClientReply:
+        try:
+            div = self.get_division(request.group_id)
+        except GroupMismatchException as e:
+            return RaftClientReply.failure_reply(request, e)
+        try:
+            return await div.submit_client_request(request)
+        except RaftException as e:
+            return RaftClientReply.failure_reply(request, e)
+        except Exception as e:  # never leak raw errors to the wire
+            LOG.exception("%s request failed", self.peer_id)
+            return RaftClientReply.failure_reply(request, RaftException(str(e)))
+
+    async def send_server_rpc(self, to: RaftPeerId, msg):
+        return await self.transport.send_server_rpc(to, msg)
+
+    def __str__(self) -> str:
+        return f"RaftServer({self.peer_id}@{self.address}, {len(self.divisions)} groups)"

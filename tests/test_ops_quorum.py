@@ -276,3 +276,40 @@ def test_kernels_jit_and_batch_10k():
         [int(x) for x in np.asarray(match[i])], int(np.argmax(self_mask[i])),
         int(flush[i]), [True] * P, [False] * P, 0, 0, True)
     assert int(out.new_commit[i]) == want
+
+
+def test_vote_scatter_first_reply_wins():
+    """A retransmitted/flipped reply must not mark a peer as both grant and
+    reject (reference ignores duplicates via responses.putIfAbsent)."""
+    g = jnp.zeros((1, 3), bool)
+    r = jnp.zeros((1, 3), bool)
+    # first batch: peer 1 grants
+    g, r = q.apply_vote_events(g, r, jnp.asarray([0], jnp.int32),
+                               jnp.asarray([1], jnp.int32),
+                               jnp.asarray([True]), jnp.asarray([True]))
+    # second batch: stale reject from the same peer -> dropped
+    g, r = q.apply_vote_events(g, r, jnp.asarray([0], jnp.int32),
+                               jnp.asarray([1], jnp.int32),
+                               jnp.asarray([False]), jnp.asarray([True]))
+    assert bool(g[0, 1]) and not bool(r[0, 1])
+
+
+def test_engine_epoch_rebase():
+    """Time arrays shift uniformly when the int32 clock approaches wrap."""
+    import asyncio
+    from ratis_tpu.engine.engine import QuorumEngine
+
+    async def main():
+        e = QuorumEngine(max_groups=4, max_peers=3)
+        slot = e.state.allocate()
+        fake_now = (1 << 30) + 500
+        e.clock._t0 -= fake_now / 1000.0  # pretend 12+ days of uptime
+        e.state.last_ack_ms[slot, :] = fake_now - 10
+        e.state.election_deadline_ms[slot] = fake_now + 150
+        now = e._maybe_rebase_epoch(e.clock.now_ms())
+        assert now < 4_000_000, now  # rebased near _REBASE_KEEP_MS (1 hour)
+        # relative distances preserved
+        assert abs(int(e.state.election_deadline_ms[slot]) - now - 150) < 50
+        assert abs(now - int(e.state.last_ack_ms[slot, 0]) - 10) < 50
+
+    asyncio.run(main())

@@ -1,0 +1,142 @@
+"""Basic Raft behavior over the simulated transport.
+
+Mirrors the reference's RaftBasicTests / LeaderElectionTests coverage
+(ratis-server/src/test/.../RaftBasicTests.java, LeaderElectionTests.java):
+single-leader election, replicated writes, reads, leader kill/failover,
+follower catch-up after partition, restart recovery.
+"""
+
+import asyncio
+
+import pytest
+
+from ratis_tpu.protocol.ids import RaftPeerId
+from tests.minicluster import MiniCluster, run_with_new_cluster
+
+
+class TestElection:
+    def test_three_peer_cluster_elects_one_leader(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            assert leader.is_leader()
+            await asyncio.sleep(0.3)  # stability: no dueling leaders
+            leaders = cluster.leaders()
+            assert len(leaders) == 1
+            assert leaders[0].member_id == leader.member_id
+            # every follower agrees on the leader
+            for d in cluster.divisions():
+                if not d.is_leader():
+                    assert d.state.leader_id == leader.member_id.peer_id
+
+        run_with_new_cluster(3, body)
+
+    def test_single_peer_self_elects(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            assert leader.is_leader()
+
+        run_with_new_cluster(1, body)
+
+
+class TestWrites:
+    def test_write_replicates_and_applies(self):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            for i in range(1, 6):
+                reply = await cluster.send_write()
+                assert reply.success
+                assert reply.message.content == str(i).encode()
+            read = await cluster.send_read()
+            assert read.message.content == b"5"
+            # all state machines converge
+            last = cluster.leaders()[0].state.log.get_last_committed_index()
+            await cluster.wait_applied(last)
+            for d in cluster.divisions():
+                assert d.state_machine.counter == 5
+
+        run_with_new_cluster(3, body)
+
+    def test_invalid_command_rejected_by_statemachine(self):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            reply = await cluster.send(b"bogus")
+            assert not reply.success
+            from ratis_tpu.protocol.exceptions import StateMachineException
+            assert isinstance(reply.exception, StateMachineException)
+            # the failed transaction must not have consumed an index
+            ok = await cluster.send_write()
+            assert ok.success and ok.message.content == b"1"
+
+        run_with_new_cluster(3, body)
+
+
+class TestFailover:
+    def test_leader_kill_triggers_reelection_and_writes_continue(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            for _ in range(3):
+                assert (await cluster.send_write()).success
+            await cluster.kill_server(leader.member_id.peer_id)
+            new_leader = await cluster.wait_for_leader()
+            assert new_leader.member_id != leader.member_id
+            reply = await cluster.send_write()
+            assert reply.success
+            assert reply.message.content == b"4"  # no committed writes lost
+
+        run_with_new_cluster(3, body)
+
+    def test_blocked_follower_catches_up(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            follower = next(d for d in cluster.divisions() if not d.is_leader())
+            fid = follower.member_id.peer_id
+            cluster.network.block(leader.member_id.peer_id, fid)
+            for _ in range(3):
+                assert (await cluster.send_write()).success
+            assert follower.state_machine.counter == 0
+            cluster.network.unblock(leader.member_id.peer_id, fid)
+            last = leader.state.log.get_last_committed_index()
+            await cluster.wait_applied(last, divisions=[follower])
+            assert follower.state_machine.counter == 3
+
+        run_with_new_cluster(3, body)
+
+    def test_minority_partition_blocks_commit_majority_restores(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            others = [d.member_id.peer_id for d in cluster.divisions()
+                      if not d.is_leader()]
+            # isolate the leader from both followers: no commits possible
+            for f in others:
+                cluster.network.block(leader.member_id.peer_id, f)
+                cluster.network.block(f, leader.member_id.peer_id)
+            write = asyncio.create_task(cluster.send(b"INCREMENT"))
+            await asyncio.sleep(0.8)
+            # a new leader must have emerged on the majority side
+            new_leader = await cluster.wait_for_leader()
+            assert new_leader.member_id.peer_id != leader.member_id.peer_id
+            cluster.network.unblock_all()
+            reply = await write
+            assert reply.success  # the client retried to the new leader
+
+        run_with_new_cluster(3, body)
+
+
+class TestRestart:
+    def test_killed_follower_restarts_and_catches_up(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            follower = next(d for d in cluster.divisions() if not d.is_leader())
+            fid = follower.member_id.peer_id
+            await cluster.kill_server(fid)
+            for _ in range(4):
+                assert (await cluster.send_write()).success
+            await cluster.restart_server(fid)
+            new_div = cluster.servers[fid].divisions[cluster.group.group_id]
+            last = (await cluster.wait_for_leader()).state.log \
+                .get_last_committed_index()
+            await cluster.wait_applied(last, divisions=[new_div])
+            # memory log restart: state rebuilt from replicated log
+            assert new_div.state_machine.counter == 4
+
+        run_with_new_cluster(3, body)
