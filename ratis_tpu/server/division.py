@@ -58,11 +58,17 @@ LOG = logging.getLogger(__name__)
 
 class Division:
     def __init__(self, server, group: RaftGroup, state_machine: StateMachine,
-                 log=None):
+                 log=None, storage=None):
         self.server = server
         self.group_id: RaftGroupId = group.group_id
         self.member_id = RaftGroupMemberId(server.peer_id, group.group_id)
-        self.state = ServerState(self.member_id, group, log=log)
+        self.storage = storage  # RaftStorageDirectory | None
+        metadata_io = None
+        if storage is not None:
+            from ratis_tpu.server.storage import FileMetadataIO
+            metadata_io = FileMetadataIO(storage)
+        self.state = ServerState(self.member_id, group, log=log,
+                                 metadata_io=metadata_io)
         self.state_machine = state_machine
         state_machine.member_id = self.member_id
 
@@ -195,7 +201,42 @@ class Division:
 
     async def start(self) -> None:
         self._running = True
-        await self.state.log.open()
+        snapshot_index = -1
+        if self.storage is not None:
+            # RECOVER path (reference ServerState.initialize:134): reload
+            # (term, votedFor), init the SM (restores its latest snapshot),
+            # then open the segmented log above the snapshot.
+            term, voted_for = self.storage.load_metadata()
+            self.state.current_term = term
+            self.state.voted_for = voted_for
+            conf_entry = self.storage.load_conf_entry()
+            if conf_entry is not None:
+                self.state.apply_log_entry_configuration(conf_entry)
+            else:
+                # First boot: record the bootstrap conf so a restart with an
+                # empty log still knows the group membership.
+                boot = self.state.configuration.to_entry(0, -1)
+                await asyncio.to_thread(self.storage.persist_conf_entry, boot)
+            await self.state_machine.initialize(
+                self.server, self.group_id, self.storage.root)
+            snap = self.state_machine.get_latest_snapshot()
+            if snap is not None:
+                snapshot_index = snap.index
+                self._applied_index = snap.index
+        else:
+            await self.state_machine.initialize(self.server, self.group_id, None)
+            snap = None
+        await self.state.log.open(snapshot_index)
+        if snap is not None and self.state.log.get_last_entry_term_index() is None:
+            # Snapshot exists but the log was purged/empty: restart the log
+            # just above the snapshot (cf. ServerState.java:153 replay start).
+            self.state.log.set_snapshot_boundary(snap.term_index)
+        # replay durable conf entries into the configuration history
+        log = self.state.log
+        for i in range(log.start_index, log.next_index):
+            e = log.get(i)
+            if e is not None and e.is_config():
+                self.state.apply_log_entry_configuration(e)
         self.attach_engine()
         self._apply_task = asyncio.create_task(
             self._apply_loop(), name=f"applier-{self.member_id}")
@@ -218,6 +259,8 @@ class Division:
         self.detach_engine()
         await self.state.log.close()
         await self.state_machine.close()
+        if self.storage is not None:
+            self.storage.unlock()
 
     # -------------------------------------------------- EngineListener API
 
@@ -622,6 +665,8 @@ class Division:
             except Exception as e:
                 exception = StateMachineException(str(e), cause=e)
         elif entry.kind == LogEntryKind.CONFIGURATION:
+            if self.storage is not None:
+                await asyncio.to_thread(self.storage.persist_conf_entry, entry)
             await sm.notify_configuration_changed(
                 entry.term, entry.index, self.state.configuration)
         await sm.notify_term_index_updated(entry.term, entry.index)

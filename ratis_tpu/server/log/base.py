@@ -152,3 +152,7 @@ class RaftLog:
     def term_at_or_before(self, index: int) -> Optional[TermIndex]:
         """TermIndex for a previous-entry check; None if purged away."""
         return self.get_term_index(index)
+
+    def set_snapshot_boundary(self, ti: TermIndex) -> None:
+        """Restart the log just above an installed/restored snapshot."""
+        raise NotImplementedError

@@ -72,10 +72,42 @@ class RaftServer:
 
     # ------------------------------------------------------------- lifecycle
 
+    def _storage_root(self) -> Optional[str]:
+        """Durable mode unless raft.server.log.use.memory is set.  The peer id
+        becomes a path component so multiple peers sharing one machine (or the
+        default dir) never collide on locks or boot-scan-adopt each other's
+        group state."""
+        if RaftServerConfigKeys.Log.use_memory(self.properties):
+            return None
+        dirs = RaftServerConfigKeys.storage_dirs(self.properties)
+        if not dirs:
+            return None
+        return f"{dirs[0]}/{self.peer_id}"
+
     async def start(self) -> None:
         self.life_cycle.transition(LifeCycleState.STARTING)
         await self.engine.start()
-        if self._initial_group is not None:
+        # Boot scan: recover every group found on disk
+        # (reference RaftServerProxy.initGroups:257-288).
+        root = self._storage_root()
+        if root is not None:
+            from ratis_tpu.server.storage import (RaftStorageDirectory,
+                                                  scan_group_dirs)
+            from ratis_tpu.server.config import RaftConfiguration
+            for gid in scan_group_dirs(root):
+                if gid in self.divisions:
+                    continue
+                sd = RaftStorageDirectory(root, gid)
+                conf_entry = sd.load_conf_entry()
+                if conf_entry is None:
+                    LOG.warning("%s: storage for %s has no conf; skipping",
+                                self.peer_id, gid)
+                    continue
+                conf = RaftConfiguration.from_entry(conf_entry)
+                group = RaftGroup.value_of(gid, conf.all_peers())
+                await self._add_division(group)
+        if self._initial_group is not None \
+                and self._initial_group.group_id not in self.divisions:
             await self._add_division(self._initial_group)
         await self.transport.start()
         self.life_cycle.transition(LifeCycleState.RUNNING)
@@ -99,10 +131,41 @@ class RaftServer:
         if group.group_id in self.divisions:
             raise AlreadyExistsException(f"{self.peer_id} already hosts {group.group_id}")
         sm = self._sm_registry(group.group_id)
-        log = self._log_factory(self, group) if self._log_factory else None
-        div = Division(self, group, sm, log=log)
+        storage = None
+        log = None
+        root = self._storage_root()
+        if self._log_factory is not None:
+            if root is not None:
+                # A durable server with a volatile injected log would persist
+                # term/vote while losing acked entries on restart — a
+                # committed-data-loss hazard.  Refuse the combination.
+                raise ValueError(
+                    "log_factory cannot be combined with durable storage; "
+                    "set raft.server.log.use.memory=true")
+            log = self._log_factory(self, group)
+        elif root is not None:
+            from ratis_tpu.server.log.segmented import LogWorker, SegmentedRaftLog
+            from ratis_tpu.server.storage import RaftStorageDirectory
+            storage = RaftStorageDirectory(root, group.group_id)
+            storage.format()
+            storage.lock()
+            log = SegmentedRaftLog(
+                f"log-{self.peer_id}-{group.group_id}", storage.current,
+                worker=LogWorker.shared(f"{self.peer_id}:{root}"),
+                segment_size_max=RaftServerConfigKeys.Log.segment_size_max(
+                    self.properties))
+        div = Division(self, group, sm, log=log, storage=storage)
         self.divisions[group.group_id] = div
-        await div.start()
+        try:
+            await div.start()
+        except Exception:
+            self.divisions.pop(group.group_id, None)
+            try:
+                await div.close()
+            except Exception:
+                LOG.exception("%s: cleanup after failed start of %s",
+                              self.peer_id, group.group_id)
+            raise
         return div
 
     async def group_add(self, group: RaftGroup) -> Division:

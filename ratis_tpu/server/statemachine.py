@@ -135,13 +135,18 @@ class StateMachine:
 
     # -- lifecycle (StateMachine.java:437-476) -------------------------------
 
-    async def initialize(self, server, group_id: RaftGroupId, storage_dir) -> None:
+    async def initialize(self, server, group_id: RaftGroupId,
+                         storage_dir=None) -> None:
+        """One SPI entry point for both durable and memory modes (the
+        reference initializes the SM even with a memory log); storage_dir is
+        None in memory mode and snapshot restore is skipped."""
         self.life_cycle.transition(LifeCycleState.STARTING)
-        self._storage.init(pathlib.Path(storage_dir) / "sm")
-        snapshot = self._storage.find_latest_snapshot()
-        if snapshot is not None:
-            await self.restore_from_snapshot(snapshot)
-            self._last_applied = snapshot.term_index
+        if storage_dir is not None:
+            self._storage.init(pathlib.Path(storage_dir) / "sm")
+            snapshot = self._storage.find_latest_snapshot()
+            if snapshot is not None:
+                await self.restore_from_snapshot(snapshot)
+                self._last_applied = snapshot.term_index
         self.life_cycle.transition(LifeCycleState.RUNNING)
 
     async def pause(self) -> None:

@@ -38,6 +38,7 @@ def fast_properties() -> RaftProperties:
     p = RaftProperties()
     RaftServerConfigKeys.Rpc.set_timeout(p, "100ms", "200ms")
     p.set("raft.tpu.engine.tick-interval", "5ms")
+    RaftServerConfigKeys.Log.set_use_memory(p, True)
     return p
 
 
@@ -45,8 +46,11 @@ class MiniCluster:
     def __init__(self, num_servers: int = 3, num_listeners: int = 0,
                  properties: Optional[RaftProperties] = None,
                  sm_factory: Callable[[], StateMachine] = CounterStateMachine,
-                 log_factory=None):
-        self.properties = properties or fast_properties()
+                 log_factory=None, storage_root: Optional[str] = None):
+        self.properties = (properties or fast_properties()).clone()
+        self.storage_root = storage_root
+        if storage_root is not None:
+            RaftServerConfigKeys.Log.set_use_memory(self.properties, False)
         self.network = SimulatedNetwork()
         self.factory = SimulatedTransportFactory(self.network)
         self.sm_factory = sm_factory
@@ -67,10 +71,15 @@ class MiniCluster:
     # ------------------------------------------------------------ lifecycle
 
     def _new_server(self, peer: RaftPeer) -> RaftServer:
+        props = self.properties
+        if self.storage_root is not None:
+            props = props.clone()
+            RaftServerConfigKeys.set_storage_dir(
+                props, f"{self.storage_root}/{peer.id}")
         return RaftServer(
             peer.id, peer.address,
             state_machine_registry=lambda gid: self.sm_factory(),
-            properties=self.properties, transport_factory=self.factory,
+            properties=props, transport_factory=self.factory,
             group=self.group, log_factory=self.log_factory)
 
     async def start(self) -> None:
