@@ -85,6 +85,46 @@ class Division:
         self._timeout_max_s = RaftServerConfigKeys.Rpc.timeout_max(p).seconds
         self.pre_vote_enabled = RaftServerConfigKeys.LeaderElection.pre_vote(p)
 
+        from ratis_tpu.server.read import (AppliedIndexWaiters, LeaseState,
+                                           WriteIndexCache)
+        from ratis_tpu.server.retrycache import RetryCache
+        from ratis_tpu.server.snapshot import SnapshotInstaller, SnapshotSender
+        from ratis_tpu.server.watch import WatchRequests
+        self.retry_cache = RetryCache(
+            RaftServerConfigKeys.RetryCache.expiry_time(p).seconds)
+        self.watch_requests = WatchRequests(
+            RaftServerConfigKeys.Watch.timeout(p).seconds,
+            RaftServerConfigKeys.Watch.element_limit(p))
+        self.applied_waiters = AppliedIndexWaiters()
+        self.write_index_cache = WriteIndexCache(
+            p.get_time_duration(
+                RaftServerConfigKeys.Read.READ_AFTER_WRITE_CONSISTENT_TIMEOUT_KEY,
+                RaftServerConfigKeys.Read
+                .READ_AFTER_WRITE_CONSISTENT_TIMEOUT_DEFAULT).seconds)
+        self.read_option = RaftServerConfigKeys.Read.option(p)
+        self.read_timeout_s = RaftServerConfigKeys.Read.timeout(p).seconds
+        self.lease = LeaseState(
+            RaftServerConfigKeys.Read.leader_lease_enabled(p),
+            RaftServerConfigKeys.Read.leader_lease_timeout_ratio(p),
+            RaftServerConfigKeys.Rpc.timeout_min(p).to_ms())
+        self.snapshot_installer = SnapshotInstaller(self)
+        self.snapshot_sender = SnapshotSender(
+            self,
+            chunk_size=p.get_size(
+                RaftServerConfigKeys.Log.Appender.SNAPSHOT_CHUNK_SIZE_MAX_KEY,
+                RaftServerConfigKeys.Log.Appender.SNAPSHOT_CHUNK_SIZE_MAX_DEFAULT),
+            install_enabled=RaftServerConfigKeys.Log.Appender
+            .install_snapshot_enabled(p))
+        self._snapshot_auto = RaftServerConfigKeys.Snapshot.auto_trigger_enabled(p)
+        self._snapshot_threshold = \
+            RaftServerConfigKeys.Snapshot.auto_trigger_threshold(p)
+        self._snapshot_retention = \
+            RaftServerConfigKeys.Snapshot.retention_file_num(p)
+        self._last_snapshot_index = -1
+        self._taking_snapshot = False
+        self._confirm_inflight: Optional[asyncio.Task] = None
+        self._last_cache_sweep = 0.0
+
         # engine wiring
         self.engine_slot: int = -1
         self.peer_slots: dict[RaftPeerId, int] = {}
@@ -115,6 +155,11 @@ class Division:
     @property
     def applied_index(self) -> int:
         return self._applied_index
+
+    def set_applied_index(self, index: int) -> None:
+        """Jump the applied frontier (snapshot install/restore)."""
+        self._applied_index = max(self._applied_index, index)
+        self.applied_waiters.advance(self._applied_index)
 
     def random_election_timeout_s(self) -> float:
         return self._rng.uniform(self._timeout_min_s, self._timeout_max_s)
@@ -279,7 +324,7 @@ class Division:
         self.state.log.update_commit_index(new_commit,
                                            self.state.current_term, True)
         self._apply_wake.set()
-        # watch/lease hooks come in later milestones
+        self._update_watch_frontiers()
 
     async def on_leadership_stale(self) -> None:
         if self.is_leader():
@@ -323,6 +368,7 @@ class Division:
         st.last_ack_ms[self.engine_slot, :] = now
         st.match_index[self.engine_slot, :] = -1
 
+        self.watch_requests.reset_frontiers()
         self.leader_ctx = LeaderContext(self)
         # Append the startup placeholder entry carrying the current conf
         # (reference appends a conf/StartupLogEntry on election,
@@ -359,9 +405,10 @@ class Division:
         if old_role == RaftPeerRole.LEADER and self.leader_ctx is not None:
             ctx = self.leader_ctx
             self.leader_ctx = None
-            await ctx.stop(NotLeaderException(
-                self.member_id, self.get_leader_peer(),
-                self.state.configuration.all_peers()))
+            nle = NotLeaderException(self.member_id, self.get_leader_peer(),
+                                     self.state.configuration.all_peers())
+            await ctx.stop(nle)
+            self.watch_requests.drain(nle)
             LOG.info("%s stepped down (%s)", self.member_id, reason)
         if old_role == RaftPeerRole.CANDIDATE and self.election is not None:
             self.election.stop()
@@ -470,23 +517,68 @@ class Division:
         return reply(AppendResult.SUCCESS, log.next_index)
 
     async def handle_install_snapshot(self, req):
-        """Chunked/notification snapshot install — snapshot milestone."""
+        """Follower side of snapshot install: chunked file mode or
+        notification mode (SnapshotInstallationHandler.java:60)."""
         from ratis_tpu.protocol.raftrpc import (InstallSnapshotReply,
                                                 InstallSnapshotResult)
-        return InstallSnapshotReply(
-            RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
-                          self.group_id),
-            self.state.current_term, InstallSnapshotResult.NOT_LEADER)
+        await injection.execute(injection.INSTALL_SNAPSHOT, self.member_id,
+                                req.header.requestor_id)
+        header = RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
+                               self.group_id)
+        state = self.state
+
+        def reply(result, snapshot_index: int = -1):
+            return InstallSnapshotReply(header, state.current_term, result,
+                                        req.request_index, snapshot_index)
+
+        if req.leader_term < state.current_term:
+            return reply(InstallSnapshotResult.NOT_LEADER)
+        if req.leader_term > state.current_term or not self.is_follower():
+            await self.change_to_follower(req.leader_term,
+                                          req.header.requestor_id,
+                                          reason="install snapshot from leader")
+        self._last_heard_leader_s = asyncio.get_event_loop().time()
+        self.reset_election_deadline()
+
+        if req.is_notification():
+            # App-managed state transfer (StateMachine.java:293).
+            installed = await self.state_machine \
+                .notify_install_snapshot_from_leader(
+                    None, req.notification_first_available)
+            if installed is not None:
+                self.state.log.set_snapshot_boundary(installed)
+                self.set_applied_index(installed.index)
+                return reply(InstallSnapshotResult.SNAPSHOT_INSTALLED,
+                             installed.index)
+            snap = self.state_machine.get_latest_snapshot()
+            if snap is not None and req.notification_first_available is not None \
+                    and snap.index + 1 >= req.notification_first_available.index:
+                return reply(InstallSnapshotResult.ALREADY_INSTALLED, snap.index)
+            return reply(InstallSnapshotResult.IN_PROGRESS)
+
+        try:
+            result = await self.snapshot_installer.receive(req)
+        except RaftException as e:
+            LOG.warning("%s snapshot install failed: %s", self.member_id, e)
+            return reply(InstallSnapshotResult.SNAPSHOT_UNAVAILABLE)
+        idx = (req.snapshot_term_index.index
+               if req.snapshot_term_index is not None else -1)
+        return reply(result, idx if result == InstallSnapshotResult.SUCCESS else -1)
 
     async def handle_read_index(self, req):
-        """Leader-side readIndex for follower-serving reads — read milestone."""
+        """Leader side of follower-served linearizable reads: confirm
+        leadership, return commitIndex (readIndexAsync in the reference)."""
         from ratis_tpu.protocol.raftrpc import ReadIndexReply
         header = RaftRpcHeader(self.member_id.peer_id, req.header.requestor_id,
                                self.group_id)
-        if not self.is_leader():
+        if not self.is_leader() or self.leader_ctx is None \
+                or self._applied_index < self.leader_ctx.startup_index:
+            return ReadIndexReply(header, False)  # not (ready as) leader
+        try:
+            read_index = await self._leader_read_index()
+        except RaftException:
             return ReadIndexReply(header, False)
-        return ReadIndexReply(header, True,
-                              self.state.log.get_last_committed_index())
+        return ReadIndexReply(header, True, read_index)
 
     async def handle_start_leader_election(self, req):
         """Transfer-leadership target: start an immediate (forced) election
@@ -516,9 +608,71 @@ class Division:
         return None
 
     async def try_install_snapshot(self, follower: FollowerInfo) -> bool:
-        """Follower is behind the purged log; snapshot install comes with the
-        snapshot milestone."""
-        return False
+        """Follower is behind the purged log: ship the snapshot
+        (GrpcLogAppender.installSnapshot:764 / notify:805 decision)."""
+        if follower.snapshot_in_progress:
+            return False
+        follower.snapshot_in_progress = True
+        try:
+            return await self.snapshot_sender.send_to(follower)
+        except Exception:
+            LOG.exception("%s snapshot install to %s failed", self.member_id,
+                          follower.peer_id)
+            return False
+        finally:
+            follower.snapshot_in_progress = False
+
+    # ------------------------------------------------------------ snapshots
+
+    async def take_snapshot_async(self) -> int:
+        """Take a snapshot now and purge the covered log
+        (StateMachineUpdater.takeSnapshot:286 + purge:80); also serves the
+        client-triggered path (SnapshotManagementRequestHandler)."""
+        if self._taking_snapshot:
+            return self._last_snapshot_index
+        self._taking_snapshot = True
+        try:
+            index = await self.state_machine.take_snapshot()
+            if index < 0:
+                return index
+            self._last_snapshot_index = index
+            if self._snapshot_retention > 0:
+                self.state_machine.get_state_machine_storage() \
+                    .clean_old_snapshots(self._snapshot_retention)
+            await self.state.log.purge(index)
+            return index
+        finally:
+            self._taking_snapshot = False
+
+    def _should_auto_snapshot(self) -> bool:
+        return (self._snapshot_auto
+                and self._applied_index - max(self._last_snapshot_index, 0)
+                >= self._snapshot_threshold)
+
+    # ------------------------------------------------------- watch frontiers
+
+    def _update_watch_frontiers(self) -> None:
+        """Recompute the four replication-level frontiers
+        (LeaderStateImpl.commitIndexChanged:579 + watchRequests.update:986)."""
+        if not self.is_leader() or self.leader_ctx is None:
+            return
+        log = self.state.log
+        commit = log.get_last_committed_index()
+        match_all = [log.flush_index]
+        commit_all = [commit]
+        commit_voting = [commit]
+        conf = self.state.configuration
+        for f in self.leader_ctx.followers.values():
+            match_all.append(f.match_index)
+            commit_all.append(f.commit_index)
+            if conf.contains_voting(f.peer_id):
+                commit_voting.append(f.commit_index)
+        majority_committed = sorted(commit_voting)[(len(commit_voting) - 1) // 2]
+        self.watch_requests.update_all_levels(
+            majority_commit=commit,
+            all_match=min(match_all),
+            majority_committed=majority_committed,
+            all_committed=min(commit_all))
 
     # --------------------------------------------------------- leader acks
 
@@ -527,6 +681,7 @@ class Division:
         if slot is not None and self.engine_slot >= 0:
             self.server.engine.on_ack(self.engine_slot, slot,
                                       follower.match_index)
+        self._update_watch_frontiers()
 
     def on_follower_heartbeat_ack(self, follower: FollowerInfo) -> None:
         slot = self.peer_slots.get(follower.peer_id)
@@ -535,10 +690,17 @@ class Division:
             now = self.server.engine.clock.now_ms()
             if st.last_ack_ms[self.engine_slot, slot] < now:
                 st.last_ack_ms[self.engine_slot, slot] = now
+        # Heartbeat replies piggyback follower commitIndex: the *_COMMITTED
+        # watch frontiers advance on them even with no new matches.
+        self._update_watch_frontiers()
 
     # ------------------------------------------------------- client path
 
     async def submit_client_request(self, req: RaftClientRequest) -> RaftClientReply:
+        if req.replied_call_ids:
+            # piggybacked retry-cache GC (RaftClientImpl.RepliedCallIds)
+            self.retry_cache.evict_replied(req.client_id.to_bytes(),
+                                           req.replied_call_ids)
         t = req.type.type
         if t == RequestType.WRITE:
             return await self._write_async(req)
@@ -546,6 +708,10 @@ class Division:
             return await self._read_async(req)
         if t == RequestType.STALE_READ:
             return await self._stale_read_async(req)
+        if t == RequestType.WATCH:
+            return await self._watch_async(req)
+        if t == RequestType.MESSAGE_STREAM:
+            return await self._message_stream_async(req)
         return RaftClientReply.failure_reply(
             req, RaftException(f"unsupported request type {t.name}"))
 
@@ -565,6 +731,33 @@ class Division:
         err = self._check_leader(req)
         if err is not None:
             return err
+        # Retry-cache dedupe (RaftServerImpl.submitClientRequestAsync:937):
+        # a retried (clientId, callId) — including after failover — waits on
+        # the original attempt's reply instead of re-executing.  Loop until we
+        # either own a fresh entry or return a completed one: when a failed
+        # attempt cancels its entry, exactly ONE concurrent retry wins the
+        # replacement entry and re-executes.
+        while True:
+            cache_entry, is_new = self.retry_cache.get_or_create(
+                req.client_id.to_bytes(), req.call_id)
+            if is_new:
+                break
+            try:
+                return await asyncio.shield(cache_entry.future)
+            except asyncio.CancelledError:
+                if not cache_entry.future.cancelled():
+                    raise  # our caller was cancelled, not the entry
+
+        reply = await self._write_impl(req)
+        if reply.success:
+            cache_entry.complete(reply)
+            self.write_index_cache.put(req.client_id.to_bytes(),
+                                       reply.log_index)
+        else:
+            cache_entry.fail()  # let a retry re-execute
+        return reply
+
+    async def _write_impl(self, req: RaftClientRequest) -> RaftClientReply:
         await injection.execute(injection.APPEND_TRANSACTION, self.member_id,
                                 req.client_id)
         try:
@@ -596,9 +789,54 @@ class Division:
         return await pending.future
 
     async def _read_async(self, req: RaftClientRequest) -> RaftClientReply:
-        err = self._check_leader(req)
-        if err is not None:
-            return err
+        from ratis_tpu.protocol.exceptions import ReadException, ReadIndexException
+        linearizable = (self.read_option ==
+                        RaftServerConfigKeys.Read.Option.LINEARIZABLE
+                        and not req.type.read_nonlinearizable)
+
+        # Read-after-write consistency (reference WriteIndexCache): wait for
+        # this client's last write to be applied locally first.
+        if req.type.read_after_write_consistent:
+            widx = self.write_index_cache.get(req.client_id.to_bytes())
+            if widx >= 0:
+                try:
+                    await self.applied_waiters.wait_applied(
+                        widx, self.read_timeout_s)
+                except asyncio.TimeoutError:
+                    return RaftClientReply.failure_reply(
+                        req, ReadException(
+                            f"read-after-write: write index {widx} not applied "
+                            f"within {self.read_timeout_s}s"))
+
+        if not linearizable:
+            err = self._check_leader(req)
+            if err is not None:
+                return err
+            return await self._query(req)
+
+        # Linearizable (Raft §6.4): get a readIndex, wait until applied.
+        try:
+            if self.is_leader():
+                # Leader-ready gate: a fresh leader's commitIndex may lag
+                # acknowledged writes until its own-term startup entry
+                # commits; serving readIndex before that breaks
+                # linearizability.
+                err = self._check_leader(req)
+                if err is not None:
+                    return err
+                read_index = await self._leader_read_index()
+            else:
+                read_index = await self._follower_read_index(req)
+            await self.applied_waiters.wait_applied(read_index,
+                                                    self.read_timeout_s)
+        except RaftException as e:
+            return RaftClientReply.failure_reply(req, e)
+        except asyncio.TimeoutError:
+            return RaftClientReply.failure_reply(
+                req, ReadIndexException("read index wait timed out"))
+        return await self._query(req)
+
+    async def _query(self, req: RaftClientRequest) -> RaftClientReply:
         try:
             result = await self.state_machine.query(req.message)
         except Exception as e:
@@ -606,6 +844,111 @@ class Division:
                 req, StateMachineException(str(e), cause=e))
         return RaftClientReply.success_reply(req, message=result,
                                              log_index=self._applied_index)
+
+    async def _leader_read_index(self) -> int:
+        """readIndex = commitIndex, after confirming we are still the leader
+        (ReadIndexHeartbeats.java:40); the heartbeat round is skipped while
+        the lease is valid (LeaderLease.java:36)."""
+        from ratis_tpu.protocol.exceptions import ReadIndexException
+        if self.leader_ctx is None:
+            raise ReadIndexException("not leader")
+        read_index = self.state.log.get_last_committed_index()
+        if self.lease.enabled and self._lease_valid():
+            return read_index
+        # Share one in-flight confirmation round among concurrent reads
+        # (reference ReadIndexHeartbeats.AppendEntriesListeners:126).
+        if self._confirm_inflight is None or self._confirm_inflight.done():
+            self._confirm_inflight = asyncio.create_task(
+                self._confirm_leadership())
+        await asyncio.shield(self._confirm_inflight)
+        return read_index
+
+    def _lease_valid(self) -> bool:
+        from ratis_tpu.ops import reference as ref
+        st = self.server.engine.state
+        slot = self.engine_slot
+        if slot < 0:
+            return False
+        expiry = ref.lease_expiry(
+            st.last_ack_ms[slot].tolist(), int(st.self_slot[slot]),
+            st.conf_cur[slot].tolist(), st.conf_old[slot].tolist(),
+            int(self.lease.lease_ms))
+        return self.server.engine.clock.now_ms() < expiry
+
+    async def _confirm_leadership(self) -> None:
+        """One empty-append round; a majority of acks proves leadership
+        (ReadIndexHeartbeats' AppendEntriesListeners:126)."""
+        from ratis_tpu.protocol.exceptions import ReadIndexException
+        conf = self.state.configuration
+        others = [p for p in conf.voting_peers()
+                  if p.id != self.member_id.peer_id]
+        if not others:
+            return
+        need = len(conf.voting_peers()) // 2 + 1 - 1  # minus self
+        log = self.state.log
+        prev = log.get_last_entry_term_index()
+
+        async def _hb(peer):
+            req = AppendEntriesRequest(
+                RaftRpcHeader(self.member_id.peer_id, peer.id, self.group_id),
+                self.state.current_term, prev, (),
+                log.get_last_committed_index())
+            reply = await self.server.send_server_rpc(peer.id, req)
+            return reply.result == AppendResult.SUCCESS \
+                or reply.result == AppendResult.INCONSISTENCY
+
+        tasks = [asyncio.create_task(_hb(p)) for p in others]
+        acks = 0
+        try:
+            for fut in asyncio.as_completed(tasks, timeout=self.read_timeout_s):
+                try:
+                    if await fut:
+                        acks += 1
+                except Exception:
+                    continue
+                if acks >= need:
+                    return
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            for t in tasks:
+                t.cancel()
+        if acks < need:
+            raise ReadIndexException(
+                f"leadership not confirmed: {acks}/{need} acks")
+
+    async def _follower_read_index(self, req: RaftClientRequest) -> int:
+        """Follower-served linearizable read: ask the leader for a readIndex
+        (reference readIndexAsync, RaftServerAsynchronousProtocol)."""
+        from ratis_tpu.protocol.exceptions import ReadIndexException
+        from ratis_tpu.protocol.raftrpc import ReadIndexRequest
+        leader = self.state.leader_id
+        if leader is None:
+            raise NotLeaderException(self.member_id, None,
+                                     self.state.configuration.all_peers())
+        rreq = ReadIndexRequest(RaftRpcHeader(self.member_id.peer_id, leader,
+                                              self.group_id))
+        reply = await self.server.send_server_rpc(leader, rreq)
+        if not reply.ok:
+            raise ReadIndexException(f"leader {leader} rejected readIndex")
+        return reply.read_index
+
+    async def _watch_async(self, req: RaftClientRequest) -> RaftClientReply:
+        """Watch an index for a replication level (WatchRequests.java:42)."""
+        err = self._check_leader(req)
+        if err is not None:
+            return err
+        try:
+            frontier = await self.watch_requests.watch(
+                req.type.watch_index, req.type.watch_replication, req.call_id)
+        except RaftException as e:
+            return RaftClientReply.failure_reply(req, e)
+        return RaftClientReply.success_reply(req, log_index=frontier)
+
+    async def _message_stream_async(self, req: RaftClientRequest) -> RaftClientReply:
+        """MessageStream sub-request accumulation — stream milestone."""
+        return RaftClientReply.failure_reply(
+            req, RaftException("message stream not yet supported"))
 
     async def _stale_read_async(self, req: RaftClientRequest) -> RaftClientReply:
         min_index = req.type.stale_read_min_index
@@ -645,11 +988,23 @@ class Division:
                 await self._apply_one(entry)
                 self._applied_index = index
                 sm.update_last_applied_term_index(entry.term, entry.index)
+            self.applied_waiters.advance(self._applied_index)
             if self.is_leader() and self.leader_ctx is not None \
                     and not self.leader_ctx.leader_ready.done() \
                     and self._applied_index >= self.leader_ctx.startup_index >= 0:
                 self.leader_ctx.leader_ready.set_result(True)
                 await sm.notify_leader_ready()
+            if self._should_auto_snapshot():
+                try:
+                    await self.take_snapshot_async()
+                except Exception:
+                    LOG.exception("%s auto snapshot failed", self.member_id)
+            # Sweep expired retry-cache entries on an interval, not per batch.
+            import time as _time
+            now = _time.monotonic()
+            if now - self._last_cache_sweep > self.retry_cache.expiry_s / 4:
+                self._last_cache_sweep = now
+                self.retry_cache.sweep()
 
     async def _apply_one(self, entry: LogEntry) -> None:
         sm = self.state_machine
@@ -664,6 +1019,19 @@ class Division:
                 reply_message = await sm.apply_transaction(trx)
             except Exception as e:
                 exception = StateMachineException(str(e), cause=e)
+            # Populate the retry cache on EVERY role at apply time so a
+            # request retried against the post-failover leader is deduped
+            # (reference RetryCacheImpl failover-safe dedupe).
+            if entry.smlog is not None and exception is None:
+                cache_entry = self.retry_cache.get_or_create_on_apply(
+                    entry.smlog.client_id, entry.smlog.call_id)
+                from ratis_tpu.protocol.ids import ClientId
+                cache_entry.complete(RaftClientReply(
+                    ClientId.value_of(entry.smlog.client_id),
+                    self.member_id.peer_id, self.group_id,
+                    entry.smlog.call_id, True,
+                    message=reply_message or Message.EMPTY,
+                    log_index=entry.index))
         elif entry.kind == LogEntryKind.CONFIGURATION:
             if self.storage is not None:
                 await asyncio.to_thread(self.storage.persist_conf_entry, entry)

@@ -94,6 +94,7 @@ class FollowerInfo:
         self.peer_id = peer_id
         self.next_index = next_index
         self.match_index = -1
+        self.commit_index = -1  # piggybacked on append replies
         self.snapshot_in_progress = False
         self.attend_vote = True  # False for listeners
 
@@ -209,6 +210,8 @@ class LogAppender:
             last_sent = (request.entries[-1].index if request.entries
                          else (request.previous.index if request.previous else -1))
             self.follower.next_index = max(self.follower.next_index, last_sent + 1)
+            self.follower.commit_index = max(self.follower.commit_index,
+                                             reply.follower_commit)
             if self.follower.update_match(reply.match_index):
                 div.on_follower_ack(self.follower)
             else:

@@ -379,6 +379,13 @@ class SegmentedRaftLog(RaftLog):
         """Drop whole segments with end <= index (snapshot-covered); the
         reference purges at segment granularity too (purgeImpl)."""
         ti = self.get_term_index(index)
+        # Roll the open segment first when the snapshot fully covers it, so
+        # purge can reclaim it too (otherwise a single-open-segment log would
+        # never shrink after snapshotting).
+        if self._segments and self._segments[-1].is_open \
+                and self._segments[-1].entries \
+                and self._segments[-1].end <= index:
+            await self._roll_segment()
         dropped = False
         while self._segments and not self._segments[0].is_open \
                 and self._segments[0].end <= index:

@@ -1,0 +1,80 @@
+"""Linearizable reads: readIndex protocol, leader lease, read-after-write.
+
+Capability parity with the reference read stack:
+- ReadIndexHeartbeats (ratis-server/.../impl/ReadIndexHeartbeats.java:40):
+  readIndex = leader commitIndex, leadership confirmed by a majority-ack
+  heartbeat round before serving (Raft §6.4).
+- LeaderLease (LeaderLease.java:36): skip the heartbeat round while
+  now < majority-ack-time + ratio*electionTimeout (the lease math runs in
+  ops.quorum.lease_expiry / ops.reference.lease_expiry).
+- ReadRequests (ReadRequests.java:35): appliedIndex -> futures completed by
+  the apply loop once the state machine reaches the readIndex.
+- WriteIndexCache (WriteIndexCache.java): clientId -> last write index for
+  read-after-write-consistent reads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import Optional
+
+
+class AppliedIndexWaiters:
+    """appliedIndex -> futures; the apply loop advances the frontier."""
+
+    def __init__(self):
+        self.heap: list[tuple[int, int, asyncio.Future]] = []
+        self._seq = 0
+        self.applied = -1
+
+    async def wait_applied(self, index: int, timeout_s: float) -> int:
+        if index <= self.applied:
+            return self.applied
+        fut = asyncio.get_event_loop().create_future()
+        self._seq += 1
+        heapq.heappush(self.heap, (index, self._seq, fut))
+        return await asyncio.wait_for(fut, timeout_s)
+
+    def advance(self, applied: int) -> None:
+        if applied <= self.applied:
+            return
+        self.applied = applied
+        while self.heap and self.heap[0][0] <= applied:
+            _, _, fut = heapq.heappop(self.heap)
+            if not fut.done():
+                fut.set_result(applied)
+
+
+class WriteIndexCache:
+    """clientId -> latest write log index (expiring)."""
+
+    def __init__(self, expiry_s: float = 60.0):
+        self._map: dict[bytes, tuple[int, float]] = {}
+        self.expiry_s = expiry_s
+
+    def put(self, client_id: bytes, index: int) -> None:
+        self._map[client_id] = (index, time.monotonic())
+
+    def get(self, client_id: bytes) -> int:
+        v = self._map.get(client_id)
+        if v is None:
+            return -1
+        index, t = v
+        if (time.monotonic() - t) > self.expiry_s:
+            del self._map[client_id]
+            return -1
+        return index
+
+
+class LeaseState:
+    """Host mirror of the lease decision; the expiry itself comes from the
+    quorum engine's last-ack majority math."""
+
+    def __init__(self, enabled: bool, ratio: float, election_timeout_ms: float):
+        self.enabled = enabled
+        self.lease_ms = ratio * election_timeout_ms
+
+    def is_valid(self, now_ms: int, lease_expiry_ms: int) -> bool:
+        return self.enabled and now_ms < lease_expiry_ms

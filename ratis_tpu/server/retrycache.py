@@ -1,0 +1,94 @@
+"""Retry cache: (clientId, callId) -> reply dedupe for retried writes.
+
+Capability parity with the reference RetryCacheImpl
+(ratis-server/.../impl/RetryCacheImpl.java:42): an expiring cache keyed by
+(clientId, callId) whose entries hold the reply future; a retried request —
+including one retried against a NEW leader after failover — returns the
+cached reply instead of re-executing.  Entries are created when a request
+enters the write path and completed at apply time, which is what makes the
+failover case work: followers populate the cache while applying replicated
+entries.  Client-piggybacked replied-call-ids GC entries early (reference
+RaftClientImpl.RepliedCallIds).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ratis_tpu.protocol.requests import RaftClientReply
+
+CacheKey = tuple[bytes, int]
+
+
+class CacheEntry:
+    def __init__(self, key: CacheKey):
+        self.key = key
+        self.future: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.created = time.monotonic()
+
+    @property
+    def done(self) -> bool:
+        return self.future.done()
+
+    def complete(self, reply: RaftClientReply) -> None:
+        if not self.future.done():
+            self.future.set_result(reply)
+
+    def fail(self) -> None:
+        """Invalidate (e.g. leadership lost before apply): the retry must
+        re-execute rather than receive a bogus cached failure."""
+        if not self.future.done():
+            self.future.cancel()
+
+
+class RetryCache:
+    def __init__(self, expiry_s: float = 60.0):
+        self._map: dict[CacheKey, CacheEntry] = {}
+        self.expiry_s = expiry_s
+        self.stats = {"hits": 0, "misses": 0}
+
+    def _expired(self, e: CacheEntry, now: float) -> bool:
+        return (now - e.created) > self.expiry_s or e.future.cancelled()
+
+    def get_or_create(self, client_id: bytes, call_id: int
+                      ) -> tuple[CacheEntry, bool]:
+        """Returns (entry, is_new)."""
+        key = (client_id, call_id)
+        now = time.monotonic()
+        e = self._map.get(key)
+        if e is not None and not self._expired(e, now):
+            self.stats["hits"] += 1
+            return e, False
+        self.stats["misses"] += 1
+        e = CacheEntry(key)
+        self._map[key] = e
+        return e, True
+
+    def get(self, client_id: bytes, call_id: int) -> Optional[CacheEntry]:
+        e = self._map.get((client_id, call_id))
+        if e is not None and self._expired(e, time.monotonic()):
+            return None
+        return e
+
+    def get_or_create_on_apply(self, client_id: bytes, call_id: int) -> CacheEntry:
+        """Apply path (any role): ensure an entry exists so post-failover
+        retries hit the cache on the new leader."""
+        e, _ = self.get_or_create(client_id, call_id)
+        return e
+
+    def evict_replied(self, client_id: bytes, call_ids) -> None:
+        for cid in call_ids:
+            self._map.pop((client_id, cid), None)
+
+    def sweep(self) -> int:
+        """Drop expired entries; called opportunistically by the apply loop."""
+        now = time.monotonic()
+        dead = [k for k, e in self._map.items() if self._expired(e, now)]
+        for k in dead:
+            del self._map[k]
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self._map)

@@ -38,6 +38,10 @@ class SimulatedNetwork:
         # (src, dst) peer-id pairs currently blackholed
         self._blocked: set[tuple[Optional[RaftPeerId], Optional[RaftPeerId]]] = set()
         self.request_timeout_s = 3.0
+        # Client requests may legitimately block server-side far longer than
+        # a server-to-server RPC (watch waits for replication, linearizable
+        # reads wait for apply) — the server-side timeout governs those.
+        self.client_request_timeout_s = 30.0
 
     # -- fault injection (cf. MiniRaftCluster.RpcBase.setBlockRequestsFrom) --
 
@@ -113,7 +117,7 @@ class SimulatedNetwork:
             raise TimeoutIOException(f"simulated: client->{target.peer_id} blocked")
         await self._hop_delay()
         return await asyncio.wait_for(target.client_handler(request),
-                                      self.request_timeout_s)
+                                      self.client_request_timeout_s)
 
 
 class SimulatedServerTransport(ServerTransport):

@@ -147,14 +147,18 @@ class MiniCluster:
     # -------------------------------------------------------------- client
 
     def _request(self, server_id: RaftPeerId, message: bytes,
-                 type_case: TypeCase) -> RaftClientRequest:
+                 type_case: TypeCase,
+                 call_id: Optional[int] = None) -> RaftClientRequest:
         return RaftClientRequest(self.client_id, server_id,
-                                 self.group.group_id, next(self._call_ids),
+                                 self.group.group_id,
+                                 call_id if call_id is not None
+                                 else next(self._call_ids),
                                  Message.value_of(message), type=type_case)
 
     async def send(self, message: bytes, type_case: Optional[TypeCase] = None,
                    server_id: Optional[RaftPeerId] = None,
-                   timeout: float = DEFAULT_TIMEOUT) -> RaftClientReply:
+                   timeout: float = DEFAULT_TIMEOUT,
+                   call_id: Optional[int] = None) -> RaftClientReply:
         """Minimal failover client: follow NotLeaderException hints, retry on
         not-ready (the full RaftClient lands with the client milestone)."""
         type_case = type_case or write_request_type()
@@ -167,10 +171,10 @@ class MiniCluster:
             if server is None:
                 target = next(iter(self.servers))
                 continue
-            req = self._request(target, message, type_case)
+            req = self._request(target, message, type_case, call_id)
             try:
                 reply = await client.send_request(server.address, req)
-            except RaftException as e:
+            except (RaftException, TimeoutError) as e:
                 last_exc = e
                 await asyncio.sleep(0.05)
                 continue

@@ -1,0 +1,243 @@
+"""Retry cache, watch, linearizable reads, snapshots.
+
+Mirrors the reference suites RetryCacheTests, WatchRequestTests,
+LinearizableReadTests and RaftSnapshotBaseTest
+(ratis-server/src/test/.../).
+"""
+
+import asyncio
+
+import pytest
+
+from ratis_tpu.conf import RaftServerConfigKeys
+from ratis_tpu.protocol.exceptions import NotReplicatedException
+from ratis_tpu.protocol.requests import (ReplicationLevel, read_request_type,
+                                         stale_read_request_type,
+                                         watch_request_type)
+from tests.minicluster import MiniCluster, fast_properties, run_with_new_cluster
+
+
+class TestRetryCache:
+    def test_same_call_id_executes_once(self):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            r1 = await cluster.send(b"INCREMENT", call_id=777)
+            r2 = await cluster.send(b"INCREMENT", call_id=777)  # retry
+            assert r1.success and r2.success
+            assert r1.message.content == r2.message.content == b"1"
+            assert r1.log_index == r2.log_index
+            r3 = await cluster.send(b"INCREMENT", call_id=778)
+            assert r3.message.content == b"2"
+
+        run_with_new_cluster(3, body)
+
+    def test_retry_after_failover_is_deduped(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            r1 = await cluster.send(b"INCREMENT", call_id=500)
+            assert r1.success and r1.message.content == b"1"
+            # make sure all peers applied (and thus populated their caches)
+            await cluster.wait_applied(r1.log_index)
+            await cluster.kill_server(leader.member_id.peer_id)
+            await cluster.wait_for_leader()
+            # the same call retried against the NEW leader must not re-execute
+            r2 = await cluster.send(b"INCREMENT", call_id=500)
+            assert r2.success
+            assert r2.message.content == b"1", r2.message
+            read = await cluster.send_read()
+            assert read.message.content == b"1"
+
+        run_with_new_cluster(3, body)
+
+
+class TestWatch:
+    def test_watch_majority_and_all(self):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            w = await cluster.send_write()
+            idx = w.log_index
+            for level in (ReplicationLevel.MAJORITY, ReplicationLevel.ALL,
+                          ReplicationLevel.MAJORITY_COMMITTED,
+                          ReplicationLevel.ALL_COMMITTED):
+                reply = await cluster.send(b"", watch_request_type(idx, level))
+                assert reply.success, (level, reply)
+                assert reply.log_index >= idx
+
+        run_with_new_cluster(3, body)
+
+    def test_watch_all_blocked_follower_times_out(self):
+        async def body(cluster: MiniCluster):
+            p = cluster.properties
+            leader = await cluster.wait_for_leader()
+            follower = next(d for d in cluster.divisions() if not d.is_leader())
+            cluster.network.block(leader.member_id.peer_id,
+                                  follower.member_id.peer_id)
+            w = await cluster.send_write()
+            # MAJORITY watch passes (2/3 alive)...
+            ok = await cluster.send(b"", watch_request_type(
+                w.log_index, ReplicationLevel.MAJORITY))
+            assert ok.success
+            # ...ALL_COMMITTED cannot while one follower is dark
+            reply = await cluster.send(b"", watch_request_type(
+                w.log_index, ReplicationLevel.ALL_COMMITTED))
+            assert not reply.success
+            assert isinstance(reply.exception, NotReplicatedException)
+            assert reply.exception.replication == ReplicationLevel.ALL_COMMITTED
+            cluster.network.unblock_all()
+
+        props = fast_properties()
+        props.set("raft.server.watch.timeout", "700ms")
+        run_with_new_cluster(3, body, properties=props)
+
+
+class TestLinearizableRead:
+    def _props(self, lease: bool = False):
+        p = fast_properties()
+        p.set(RaftServerConfigKeys.Read.OPTION_KEY, "LINEARIZABLE")
+        if lease:
+            p.set_boolean(RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY, True)
+        return p
+
+    def test_leader_linearizable_read(self):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            for i in range(1, 4):
+                await cluster.send_write()
+            r = await cluster.send_read()
+            assert r.success and r.message.content == b"3"
+
+        run_with_new_cluster(3, body, properties=self._props())
+
+    def test_follower_serves_linearizable_read_via_read_index(self):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            await cluster.send_write()
+            follower = next(d for d in cluster.divisions() if not d.is_leader())
+            r = await cluster.send(b"GET", read_request_type(),
+                                   server_id=follower.member_id.peer_id)
+            assert r.success and r.message.content == b"1"
+            # served by the follower itself, not redirected:
+            assert r.server_id == follower.member_id.peer_id
+
+        run_with_new_cluster(3, body, properties=self._props())
+
+    def test_lease_read(self):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            await cluster.send_write()
+            r = await cluster.send_read()
+            assert r.success and r.message.content == b"1"
+
+        run_with_new_cluster(3, body, properties=self._props(lease=True))
+
+    def test_stale_read_from_follower(self):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            w = await cluster.send_write()
+            await cluster.wait_applied(w.log_index)
+            follower = next(d for d in cluster.divisions() if not d.is_leader())
+            r = await cluster.send(b"GET",
+                                   stale_read_request_type(w.log_index),
+                                   server_id=follower.member_id.peer_id)
+            assert r.success and r.message.content == b"1"
+
+        run_with_new_cluster(3, body)
+
+
+class TestSnapshot:
+    def _props(self, threshold=5):
+        p = fast_properties()
+        p.set_boolean(RaftServerConfigKeys.Snapshot.AUTO_TRIGGER_ENABLED_KEY, True)
+        p.set_int(RaftServerConfigKeys.Snapshot.AUTO_TRIGGER_THRESHOLD_KEY,
+                  threshold)
+        return p
+
+    def test_auto_snapshot_and_purge(self, tmp_path):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            for _ in range(12):
+                assert (await cluster.send_write()).success
+            # leader should have snapshotted and purged its log
+            deadline = asyncio.get_event_loop().time() + 5
+            leader = cluster.leaders()[0]
+            while asyncio.get_event_loop().time() < deadline:
+                if leader.state_machine.get_latest_snapshot() is not None \
+                        and leader.state.log.start_index > 0:
+                    break
+                await asyncio.sleep(0.05)
+            snap = leader.state_machine.get_latest_snapshot()
+            assert snap is not None and snap.index >= 5
+            assert leader.state.log.start_index > 0
+
+        async def main():
+            cluster = MiniCluster(3, properties=self._props(),
+                                  storage_root=str(tmp_path))
+            await cluster.start()
+            try:
+                await body(cluster)
+            finally:
+                await cluster.close()
+
+        asyncio.run(main())
+
+    def test_lagging_follower_gets_snapshot_install(self, tmp_path):
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            follower = next(d for d in cluster.divisions() if not d.is_leader())
+            fid = follower.member_id.peer_id
+            await cluster.kill_server(fid)
+            for _ in range(12):
+                assert (await cluster.send_write()).success
+            leader = cluster.leaders()[0]
+            await leader.take_snapshot_async()
+            assert leader.state.log.start_index > 0
+            # restart the follower: it is behind the purged log, must get
+            # the snapshot installed
+            await cluster.restart_server(fid)
+            div = cluster.servers[fid].divisions[cluster.group.group_id]
+            deadline = asyncio.get_event_loop().time() + 8
+            while asyncio.get_event_loop().time() < deadline:
+                if div.state_machine.counter == 12:
+                    break
+                await asyncio.sleep(0.05)
+            assert div.state_machine.counter == 12, div.state_machine.counter
+            snap = div.state_machine.get_latest_snapshot()
+            assert snap is not None
+
+        async def main():
+            cluster = MiniCluster(3, storage_root=str(tmp_path))
+            await cluster.start()
+            try:
+                await body(cluster)
+            finally:
+                await cluster.close()
+
+        asyncio.run(main())
+
+    def test_restart_from_snapshot(self, tmp_path):
+        async def body(cluster: MiniCluster):
+            await cluster.wait_for_leader()
+            for _ in range(8):
+                assert (await cluster.send_write()).success
+            for d in cluster.divisions():
+                await cluster.wait_applied(7, divisions=[d])
+            for d in cluster.divisions():
+                await d.take_snapshot_async()
+            for pid in list(cluster.servers):
+                await cluster.kill_server(pid)
+            for pid in list(cluster._stopped):
+                await cluster.restart_server(pid)
+            await cluster.wait_for_leader()
+            r = await cluster.send_read()
+            assert r.message.content == b"8"
+            assert (await cluster.send_write()).message.content == b"9"
+
+        async def main():
+            cluster = MiniCluster(3, storage_root=str(tmp_path))
+            await cluster.start()
+            try:
+                await body(cluster)
+            finally:
+                await cluster.close()
+
+        asyncio.run(main())
