@@ -218,25 +218,8 @@ class QuorumEngine:
     def _kernels(self):
         if "step" not in self._jit_cache:
             import jax
-            import jax.numpy as jnp
             from ratis_tpu.ops import quorum as q
-
-            def step(match, last_ack, evg, evp, evm, evt, evv, self_mask,
-                     flush, conf_cur, conf_old, commit, first, role, deadline,
-                     now, lead_timeout):
-                match, last_ack = q.apply_ack_events(match, last_ack, evg, evp,
-                                                     evm, evt, evv)
-                is_leader = role == ROLE_LEADER
-                cu = q.update_commit(match, self_mask, flush, conf_cur,
-                                     conf_old, commit, first, is_leader)
-                timeouts = q.election_timeout(now, deadline,
-                                              role == ROLE_FOLLOWER)
-                stale = q.check_leadership(last_ack, self_mask, conf_cur,
-                                           conf_old, now, lead_timeout,
-                                           is_leader)
-                return match, last_ack, cu.new_commit, cu.changed, timeouts, stale
-
-            self._jit_cache["step"] = jax.jit(step)
+            self._jit_cache["step"] = jax.jit(q.engine_step)
         return self._jit_cache["step"]
 
     def _tick_batched(self, acks, now: int) -> list[tuple[int, str, int]]:
@@ -266,8 +249,10 @@ class QuorumEngine:
             jnp.asarray(s.election_deadline_ms), jnp.int32(now),
             jnp.int32(self.leadership_timeout_ms))
 
-        s.match_index = np.asarray(match)
-        s.last_ack_ms = np.asarray(last_ack)
+        # np.asarray over a jax array is a read-only view; divisions mutate
+        # these between ticks, so copy back into writable buffers.
+        np.copyto(s.match_index, np.asarray(match))
+        np.copyto(s.last_ack_ms, np.asarray(last_ack))
         new_commit_np = np.asarray(new_commit)
         commit_changed_np = np.asarray(commit_changed)
         timeouts_np = np.asarray(timeouts)

@@ -17,12 +17,10 @@ from typing import Optional
 
 import numpy as np
 
-# role codes (device-friendly int8)
-ROLE_UNUSED = 0
-ROLE_FOLLOWER = 1
-ROLE_CANDIDATE = 2
-ROLE_LEADER = 3
-ROLE_LISTENER = 4
+# role codes (device-friendly int8; defined beside the kernels that match on
+# them, re-exported here for the host runtime)
+from ratis_tpu.ops.quorum import (ROLE_CANDIDATE, ROLE_FOLLOWER,  # noqa: F401
+                                  ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
 
 NO_DEADLINE = np.iinfo(np.int32).max
 

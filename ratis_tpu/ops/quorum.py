@@ -35,6 +35,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+# Role codes shared with the host runtime (engine.state re-exports these).
+ROLE_UNUSED = 0
+ROLE_FOLLOWER = 1
+ROLE_CANDIDATE = 2
+ROLE_LEADER = 3
+ROLE_LISTENER = 4
+
+
 def conf_size(mask: jax.Array) -> jax.Array:
     """[G, P] bool -> [G] number of voting members."""
     return jnp.sum(mask, axis=-1)
@@ -239,6 +247,47 @@ def apply_ack_events(match_index: jax.Array, last_ack_ms: jax.Array,
     new_match = match_index.at[g, p].max(m, mode="drop")
     new_ack = last_ack_ms.at[g, p].max(t, mode="drop")
     return new_match, new_ack
+
+
+class EngineStep(NamedTuple):
+    match_index: jax.Array    # [G, P] updated
+    last_ack_ms: jax.Array    # [G, P] updated
+    new_commit: jax.Array     # [G]
+    commit_changed: jax.Array # [G] bool
+    timeouts: jax.Array       # [G] bool followers to become candidates
+    stale: jax.Array          # [G] bool leaders that lost quorum contact
+
+
+def engine_step(match_index: jax.Array, last_ack_ms: jax.Array,
+                ev_group: jax.Array, ev_peer: jax.Array, ev_match: jax.Array,
+                ev_time_ms: jax.Array, ev_valid: jax.Array,
+                self_mask: jax.Array, flush_index: jax.Array,
+                conf_cur: jax.Array, conf_old: jax.Array,
+                commit_index: jax.Array, first_leader_index: jax.Array,
+                role: jax.Array, election_deadline_ms: jax.Array,
+                now_ms: jax.Array, leadership_timeout_ms: jax.Array
+                ) -> EngineStep:
+    """One fused engine tick for every group a host serves: scatter the packed
+    ack batch, advance commits, fire election timeouts, detect stale leaders.
+
+    This is the framework's flagship compiled program — the single XLA
+    dispatch that replaces the reference's per-division EventProcessor +
+    FollowerState + checkLeadership daemons (LeaderStateImpl.java:108-190,
+    FollowerState.java:64, LeaderStateImpl.java:1096) for the whole server.
+    Role codes match engine.state: 1=follower, 3=leader.
+    """
+    match_index, last_ack_ms = apply_ack_events(
+        match_index, last_ack_ms, ev_group, ev_peer, ev_match, ev_time_ms,
+        ev_valid)
+    is_leader = role == ROLE_LEADER
+    cu = update_commit(match_index, self_mask, flush_index, conf_cur,
+                       conf_old, commit_index, first_leader_index, is_leader)
+    timeouts = election_timeout(now_ms, election_deadline_ms,
+                                role == ROLE_FOLLOWER)
+    stale = check_leadership(last_ack_ms, self_mask, conf_cur, conf_old,
+                             now_ms, leadership_timeout_ms, is_leader)
+    return EngineStep(match_index, last_ack_ms, cu.new_commit, cu.changed,
+                      timeouts, stale)
 
 
 def apply_vote_events(grants: jax.Array, rejects: jax.Array,
