@@ -1,0 +1,10 @@
+"""Client package: RaftClient and sub-APIs (reference ratis-client)."""
+
+from ratis_tpu.client.client import (AdminApi, GroupManagementApi,
+                                     LeaderElectionManagementApi, OrderedApi,
+                                     RaftClient, RaftClientBuilder,
+                                     SnapshotManagementApi)
+
+__all__ = ["RaftClient", "RaftClientBuilder", "OrderedApi", "AdminApi",
+           "GroupManagementApi", "SnapshotManagementApi",
+           "LeaderElectionManagementApi"]

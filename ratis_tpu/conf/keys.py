@@ -37,6 +37,12 @@ class RaftServerConfigKeys:
     STORAGE_DIR_DEFAULT = "/tmp/ratis-tpu"
     STORAGE_FREE_SPACE_MIN_KEY = "raft.server.storage.free-space.min"
     STORAGE_FREE_SPACE_MIN_DEFAULT = "0MB"
+    # setConfiguration staging: a bootstrapping peer is "caught up" once it is
+    # within this many entries of the leader's last index (reference
+    # RaftServerConfigKeys stagingCatchupGap, used by LeaderStateImpl
+    # checkStaging:828).
+    STAGING_CATCHUP_GAP_KEY = "raft.server.staging.catchup.gap"
+    STAGING_CATCHUP_GAP_DEFAULT = 1000
 
     @staticmethod
     def storage_dirs(p: RaftProperties) -> list[str]:

@@ -1,0 +1,14 @@
+"""Device-side role codes, shared by the host state arrays and the kernels.
+
+Dependency-free on purpose: ops.quorum (jax) and engine.state (numpy) both
+import from here, so importing the host server stack never pays jax init.
+Distinct from protocol.peer.RaftPeerRole, whose values are wire-stable
+(Raft.proto RaftPeerRole) — these are the int8 codes stored in the [G] role
+array the kernels match on.
+"""
+
+ROLE_UNUSED = 0
+ROLE_FOLLOWER = 1
+ROLE_CANDIDATE = 2
+ROLE_LEADER = 3
+ROLE_LISTENER = 4

@@ -17,10 +17,9 @@ from typing import Optional
 
 import numpy as np
 
-# role codes (device-friendly int8; defined beside the kernels that match on
-# them, re-exported here for the host runtime)
-from ratis_tpu.ops.quorum import (ROLE_CANDIDATE, ROLE_FOLLOWER,  # noqa: F401
-                                  ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
+# role codes (device-friendly int8; see engine.roles — shared with kernels)
+from ratis_tpu.engine.roles import (ROLE_CANDIDATE, ROLE_FOLLOWER,  # noqa: F401
+                                    ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
 
 NO_DEADLINE = np.iinfo(np.int32).max
 

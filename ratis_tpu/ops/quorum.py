@@ -35,12 +35,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-# Role codes shared with the host runtime (engine.state re-exports these).
-ROLE_UNUSED = 0
-ROLE_FOLLOWER = 1
-ROLE_CANDIDATE = 2
-ROLE_LEADER = 3
-ROLE_LISTENER = 4
+from ratis_tpu.engine.roles import (ROLE_CANDIDATE, ROLE_FOLLOWER,  # noqa: F401
+                                    ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
 
 
 def conf_size(mask: jax.Array) -> jax.Array:

@@ -1,0 +1,206 @@
+"""Admin request/reply payloads (membership, leadership, snapshot, groups).
+
+Capability parity with the reference admin protos
+(Raft.proto: SetConfigurationRequestProto:427, TransferLeadershipRequestProto
+:442, SnapshotManagementRequestProto:466, LeaderElectionManagementRequest
+:478, GroupManagementRequestProto:488-516, GroupListRequest/GroupInfoRequest)
+and their client-side wrappers (ratis-client/.../impl/{AdminImpl,
+GroupManagementImpl,SnapshotManagementImpl,LeaderElectionManagementImpl}).
+
+Admin operations travel on the ordinary client channel: the typed payload is
+msgpack-encoded into the RaftClientRequest message body, with a dedicated
+RequestType tag per operation (requests.RequestType.SET_CONFIGURATION etc.).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import msgpack
+
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import RaftGroupId
+from ratis_tpu.protocol.peer import RaftPeer
+
+
+class SetConfigurationMode(enum.IntEnum):
+    """Raft.proto SetConfigurationRequestProto.Mode."""
+
+    SET_UNCONDITIONALLY = 0
+    ADD = 1
+    REMOVE = 2
+    COMPARE_AND_SET = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class SetConfigurationArguments:
+    """New membership for a group (reference SetConfigurationRequest)."""
+
+    peers: tuple[RaftPeer, ...] = ()       # voting servers in the new conf
+    listeners: tuple[RaftPeer, ...] = ()
+    mode: SetConfigurationMode = SetConfigurationMode.SET_UNCONDITIONALLY
+    # COMPARE_AND_SET precondition: the exact current voting membership.
+    current_peers: tuple[RaftPeer, ...] = ()
+
+    def to_payload(self) -> bytes:
+        return msgpack.packb({
+            "p": [p.to_dict() for p in self.peers],
+            "l": [p.to_dict() for p in self.listeners],
+            "m": int(self.mode),
+            "cp": [p.to_dict() for p in self.current_peers],
+        }, use_bin_type=True)
+
+    @staticmethod
+    def from_payload(b: bytes) -> "SetConfigurationArguments":
+        d = msgpack.unpackb(b, raw=False)
+        return SetConfigurationArguments(
+            peers=tuple(RaftPeer.from_dict(x) for x in d["p"]),
+            listeners=tuple(RaftPeer.from_dict(x) for x in d["l"]),
+            mode=SetConfigurationMode(d["m"]),
+            current_peers=tuple(RaftPeer.from_dict(x) for x in d.get("cp", ())))
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferLeadershipArguments:
+    """Move leadership to a peer (TransferLeadershipRequestProto:442);
+    empty new_leader means 'yield to any higher-priority peer'."""
+
+    new_leader: Optional[str] = None  # peer id string
+    timeout_ms: float = 3000.0
+
+    def to_payload(self) -> bytes:
+        return msgpack.packb({"nl": self.new_leader, "to": self.timeout_ms},
+                             use_bin_type=True)
+
+    @staticmethod
+    def from_payload(b: bytes) -> "TransferLeadershipArguments":
+        d = msgpack.unpackb(b, raw=False)
+        return TransferLeadershipArguments(d.get("nl"), d.get("to", 3000.0))
+
+
+class SnapshotManagementOp(enum.IntEnum):
+    CREATE = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotManagementArguments:
+    """SnapshotManagementRequestProto:466 (create with a creation gap: skip
+    if the latest snapshot is within `creation_gap` entries of applied)."""
+
+    op: SnapshotManagementOp = SnapshotManagementOp.CREATE
+    creation_gap: int = 0  # 0 = use server default
+
+    def to_payload(self) -> bytes:
+        return msgpack.packb({"op": int(self.op), "gap": self.creation_gap},
+                             use_bin_type=True)
+
+    @staticmethod
+    def from_payload(b: bytes) -> "SnapshotManagementArguments":
+        d = msgpack.unpackb(b, raw=False)
+        return SnapshotManagementArguments(SnapshotManagementOp(d["op"]),
+                                           d.get("gap", 0))
+
+
+class LeaderElectionManagementOp(enum.IntEnum):
+    PAUSE = 1
+    RESUME = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderElectionManagementArguments:
+    """LeaderElectionManagementRequest (Raft.proto:478)."""
+
+    op: LeaderElectionManagementOp = LeaderElectionManagementOp.PAUSE
+
+    def to_payload(self) -> bytes:
+        return msgpack.packb({"op": int(self.op)}, use_bin_type=True)
+
+    @staticmethod
+    def from_payload(b: bytes) -> "LeaderElectionManagementArguments":
+        d = msgpack.unpackb(b, raw=False)
+        return LeaderElectionManagementArguments(
+            LeaderElectionManagementOp(d["op"]))
+
+
+class GroupManagementOp(enum.IntEnum):
+    ADD = 1
+    REMOVE = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupManagementArguments:
+    """GroupManagementRequestProto:488 (add carries the full group; remove
+    carries the id + directory disposition)."""
+
+    op: GroupManagementOp
+    group: Optional[RaftGroup] = None           # ADD
+    group_id: Optional[RaftGroupId] = None      # REMOVE
+    delete_directory: bool = False
+    format_enabled: bool = False  # ADD: reformat existing storage
+
+    def to_payload(self) -> bytes:
+        d: dict = {"op": int(self.op), "del": self.delete_directory,
+                   "fmt": self.format_enabled}
+        if self.group is not None:
+            d["g"] = {"gid": self.group.group_id.to_bytes(),
+                      "peers": [p.to_dict() for p in self.group.peers]}
+        if self.group_id is not None:
+            d["gid"] = self.group_id.to_bytes()
+        return msgpack.packb(d, use_bin_type=True)
+
+    @staticmethod
+    def from_payload(b: bytes) -> "GroupManagementArguments":
+        d = msgpack.unpackb(b, raw=False)
+        group = None
+        if "g" in d:
+            group = RaftGroup.value_of(
+                RaftGroupId.value_of(d["g"]["gid"]),
+                [RaftPeer.from_dict(x) for x in d["g"]["peers"]])
+        gid = RaftGroupId.value_of(d["gid"]) if "gid" in d else None
+        return GroupManagementArguments(
+            GroupManagementOp(d["op"]), group=group, group_id=gid,
+            delete_directory=d.get("del", False),
+            format_enabled=d.get("fmt", False))
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupInfoReplyData:
+    """GroupInfoReply payload (reference GroupInfoReply + RoleInfoProto:537)."""
+
+    group: RaftGroup
+    role: str
+    term: int
+    leader_id: Optional[str]
+    commit_index: int
+    applied_index: int
+    is_leader_ready: bool
+
+    def to_payload(self) -> bytes:
+        return msgpack.packb({
+            "gid": self.group.group_id.to_bytes(),
+            "peers": [p.to_dict() for p in self.group.peers],
+            "role": self.role, "term": self.term,
+            "leader": self.leader_id, "ci": self.commit_index,
+            "ai": self.applied_index, "ready": self.is_leader_ready,
+        }, use_bin_type=True)
+
+    @staticmethod
+    def from_payload(b: bytes) -> "GroupInfoReplyData":
+        d = msgpack.unpackb(b, raw=False)
+        return GroupInfoReplyData(
+            group=RaftGroup.value_of(
+                RaftGroupId.value_of(d["gid"]),
+                [RaftPeer.from_dict(x) for x in d["peers"]]),
+            role=d["role"], term=d["term"], leader_id=d.get("leader"),
+            commit_index=d["ci"], applied_index=d["ai"],
+            is_leader_ready=d["ready"])
+
+
+def encode_group_list(group_ids: list[RaftGroupId]) -> bytes:
+    return msgpack.packb([g.to_bytes() for g in group_ids], use_bin_type=True)
+
+
+def decode_group_list(b: bytes) -> list[RaftGroupId]:
+    return [RaftGroupId.value_of(x) for x in msgpack.unpackb(b, raw=False)]

@@ -40,6 +40,15 @@ class RequestType(enum.IntEnum):
     MESSAGE_STREAM = 5
     DATA_STREAM = 6
     FORWARD = 7
+    # Admin operations (payload msgpack-encoded in the message body; see
+    # ratis_tpu.protocol.admin — mirrors Raft.proto admin protos :427-516).
+    SET_CONFIGURATION = 8
+    TRANSFER_LEADERSHIP = 9
+    SNAPSHOT_MANAGEMENT = 10
+    LEADER_ELECTION_MANAGEMENT = 11
+    GROUP_MANAGEMENT = 12
+    GROUP_LIST = 13
+    GROUP_INFO = 14
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +93,10 @@ def message_stream_request_type(stream_id: int, message_id: int,
                                 end_of_request: bool) -> TypeCase:
     return TypeCase(RequestType.MESSAGE_STREAM, stream_id=stream_id,
                     message_id=message_id, end_of_request=end_of_request)
+
+
+def admin_request_type(t: RequestType) -> TypeCase:
+    return TypeCase(t)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -138,6 +138,11 @@ class Division:
         self._rng = random.Random(hash((str(self.member_id),)) & 0xFFFFFFFF)
         self._last_heard_leader_s = 0.0
 
+        # admin state
+        self.pending_reconf = None  # Optional[admin.PendingReconf]
+        self.stepping_down = False  # transfer-leadership in progress
+        self._election_paused = False
+
     # ------------------------------------------------------------------ util
 
     def is_leader(self) -> bool:
@@ -189,17 +194,43 @@ class Division:
 
     def _assign_peer_slots(self) -> None:
         """Stable peer->column mapping for the [G, P] arrays.  Existing
-        assignments survive conf changes; new peers take free columns."""
+        assignments survive conf changes; new peers take free columns;
+        columns of long-gone peers are recycled under membership churn."""
+        def _take_free() -> int:
+            used = set(self.peer_slots.values())
+            for i in range(self.max_peers):
+                if i not in used:
+                    return i
+            self._free_stale_slots()
+            used = set(self.peer_slots.values())
+            for i in range(self.max_peers):
+                if i not in used:
+                    return i
+            raise RaftException(
+                f"{self.member_id}: peer-slot columns exhausted "
+                f"({self.max_peers}); raise raft.tpu.engine.max-peers")
+
         for peer in sorted(self.state.configuration.all_peers(),
                            key=lambda p: p.id.id):
             if peer.id not in self.peer_slots:
-                used = set(self.peer_slots.values())
-                free = next(i for i in range(self.max_peers) if i not in used)
-                self.peer_slots[peer.id] = free
+                self.peer_slots[peer.id] = _take_free()
         if self.member_id.peer_id not in self.peer_slots:
-            used = set(self.peer_slots.values())
-            free = next(i for i in range(self.max_peers) if i not in used)
-            self.peer_slots[self.member_id.peer_id] = free
+            self.peer_slots[self.member_id.peer_id] = _take_free()
+
+    def _free_stale_slots(self) -> None:
+        """Recycle columns of peers in neither conf nor the follower set."""
+        keep = {p.id for p in self.state.configuration.all_peers()}
+        keep.add(self.member_id.peer_id)
+        if self.leader_ctx is not None:
+            keep |= set(self.leader_ctx.followers)
+        st = self.server.engine.state
+        for pid in list(self.peer_slots):
+            if pid not in keep:
+                col = self.peer_slots.pop(pid)
+                if self.engine_slot >= 0:
+                    st.match_index[self.engine_slot, col] = -1
+                    st.last_ack_ms[self.engine_slot, col] = 0
+                    st.priority[self.engine_slot, col] = 0
 
     def _sync_conf_to_engine(self) -> None:
         import numpy as np
@@ -312,7 +343,9 @@ class Division:
     async def on_election_timeout(self) -> None:
         if not self._running or not self.is_follower():
             return
-        if not self.state.configuration.contains_voting(self.member_id.peer_id):
+        if self._election_paused \
+                or not self.state.configuration.contains_voting(
+                    self.member_id.peer_id):
             self.reset_election_deadline()
             return
         await self.change_to_candidate()
@@ -412,6 +445,11 @@ class Division:
             LOG.info("%s stepped down (%s)", self.member_id, reason)
         if old_role == RaftPeerRole.CANDIDATE and self.election is not None:
             self.election.stop()
+        if self.pending_reconf is not None \
+                and not self.pending_reconf.future.done():
+            self.pending_reconf.future.set_exception(
+                NotLeaderException(self.member_id, self.get_leader_peer(),
+                                   self.state.configuration.all_peers()))
         self.reset_election_deadline()
 
     # ------------------------------------------------------- follower RPCs
@@ -506,7 +544,7 @@ class Division:
             for e in req.entries:
                 if e.is_config():
                     state.apply_log_entry_configuration(e)
-                    self._sync_conf_to_engine()
+                    self.on_configuration_changed()
             self._engine_update_flush()
 
         # Follower commit: min(leaderCommit, last local index).
@@ -694,6 +732,105 @@ class Division:
         # watch frontiers advance on them even with no new matches.
         self._update_watch_frontiers()
 
+    # ------------------------------------------------- configuration change
+
+    def on_configuration_changed(self) -> None:
+        """Re-sync slots/masks/appenders after the effective conf changed
+        (leader append, follower append, truncate rollback)."""
+        self._assign_peer_slots()
+        self._sync_conf_to_engine()
+        # Listener promoted to voting member: voting rights begin as soon as
+        # the conf entry is in the log (Raft uses a conf once appended);
+        # demotion waits for commit (see _on_conf_entry_applied).
+        if self.is_listener() and self.state.configuration.contains_voting(
+                self.member_id.peer_id):
+            self.role = RaftPeerRole.FOLLOWER
+            self._engine_set_role(ROLE_FOLLOWER)
+            self.reset_election_deadline()
+        if self.is_leader() and self.leader_ctx is not None:
+            ctx = self.leader_ctx
+            next_index = self.state.log.next_index
+            wanted = {p.id for p in self.state.configuration.all_peers()
+                      if p.id != self.member_id.peer_id}
+            for pid in wanted:
+                if pid not in ctx.followers:
+                    ctx.add_follower(pid, next_index)
+            for pid in list(ctx.followers):
+                if pid not in wanted:
+                    # keep staged (pre-conf) followers; drop removed members
+                    if self.pending_reconf is None:
+                        asyncio.ensure_future(ctx.remove_follower(pid))
+
+    def add_peer_for_staging(self, peer: RaftPeer) -> None:
+        """Bootstrap a brand-new member before it enters the conf
+        (LeaderStateImpl BootStrapProgress / addSenders for staging)."""
+        assert self.leader_ctx is not None
+        self.leader_ctx.add_follower(peer.id, self.state.log.next_index)
+
+    async def remove_staged_peer(self, peer_id: RaftPeerId) -> None:
+        if self.leader_ctx is not None \
+                and self.state.configuration.get_peer(peer_id) is None:
+            await self.leader_ctx.remove_follower(peer_id)
+
+    async def _on_conf_entry_applied(self, entry: LogEntry) -> None:
+        """Leader-side joint-consensus progression: applied JOINT entry ->
+        append the stable conf; applied STABLE entry -> complete the pending
+        setConfiguration and step down if we were removed
+        (reference LeaderStateImpl.updateConfiguration + replyPending)."""
+        applied_conf = RaftConfiguration.from_entry(entry)
+        state = self.state
+        if self.is_leader() and self.leader_ctx is not None:
+            if applied_conf.is_transitional():
+                cur = state.configuration
+                if cur.is_transitional() and cur.log_index == entry.index:
+                    log = state.log
+                    index = log.next_index
+                    stable = RaftConfiguration(applied_conf.conf, None, index)
+                    if self.pending_reconf is not None:
+                        self.pending_reconf.final_index = index
+                    stable_entry = stable.to_entry(state.current_term, index)
+                    await log.append_entry(stable_entry)
+                    state.apply_log_entry_configuration(stable_entry)
+                    self.on_configuration_changed()
+                    self._engine_update_flush()
+                    self.leader_ctx.notify_appenders()
+                return
+            # stable conf applied while leading
+            if self.pending_reconf is not None \
+                    and entry.index == self.pending_reconf.final_index \
+                    and not self.pending_reconf.future.done():
+                self.pending_reconf.future.set_result(entry.index)
+            # drop appenders of members that left (unless a reconf is still
+            # staging new peers, whose appenders predate their conf entry)
+            if self.pending_reconf is None \
+                    or self.pending_reconf.joint_index >= 0:
+                wanted = {p.id for p in state.configuration.all_peers()}
+                for pid in list(self.leader_ctx.followers):
+                    if pid not in wanted:
+                        await self.leader_ctx.remove_follower(pid)
+        if applied_conf.is_transitional():
+            return
+        # Role reconciliation against the committed stable conf (every role):
+        # a member demoted from the voting set — or removed outright — drops
+        # leadership/candidacy only once the conf is committed (Raft §6:
+        # a removed leader steps down after C_new is committed).
+        me = self.member_id.peer_id
+        voting = applied_conf.contains_voting(me)
+        in_conf = applied_conf.get_peer(me) is not None
+        if not voting and not self.is_listener():
+            if self.is_leader() or self.is_candidate():
+                await self.change_to_follower(
+                    state.current_term, None,
+                    reason="no longer a voting member")
+            if in_conf:
+                # demoted to listener: replicate, never vote or campaign
+                self.role = RaftPeerRole.LISTENER
+                self._engine_set_role(ROLE_LISTENER)
+                if self.engine_slot >= 0:
+                    from ratis_tpu.engine.state import NO_DEADLINE
+                    self.server.engine.state.election_deadline_ms[
+                        self.engine_slot] = NO_DEADLINE
+
     # ------------------------------------------------------- client path
 
     async def submit_client_request(self, req: RaftClientRequest) -> RaftClientReply:
@@ -712,6 +849,18 @@ class Division:
             return await self._watch_async(req)
         if t == RequestType.MESSAGE_STREAM:
             return await self._message_stream_async(req)
+        if t == RequestType.SET_CONFIGURATION:
+            from ratis_tpu.server import admin
+            return await admin.set_configuration(self, req)
+        if t == RequestType.TRANSFER_LEADERSHIP:
+            from ratis_tpu.server import admin
+            return await admin.transfer_leadership(self, req)
+        if t == RequestType.SNAPSHOT_MANAGEMENT:
+            return await self._snapshot_mgmt_async(req)
+        if t == RequestType.LEADER_ELECTION_MANAGEMENT:
+            return await self._election_mgmt_async(req)
+        if t == RequestType.GROUP_INFO:
+            return self._group_info(req)
         return RaftClientReply.failure_reply(
             req, RaftException(f"unsupported request type {t.name}"))
 
@@ -720,6 +869,10 @@ class Division:
             return RaftClientReply.failure_reply(
                 req, NotLeaderException(self.member_id, self.get_leader_peer(),
                                         self.state.configuration.all_peers()))
+        if self.stepping_down:
+            return RaftClientReply.failure_reply(
+                req, LeaderSteppingDownException(
+                    f"{self.member_id} is stepping down (leadership transfer)"))
         if not self.leader_ctx.leader_ready.done():
             # Leader until the startup entry commits: retryable not-ready.
             if self._applied_index < self.leader_ctx.startup_index:
@@ -964,6 +1117,72 @@ class Division:
         return RaftClientReply.success_reply(req, message=result,
                                              log_index=self._applied_index)
 
+    # ----------------------------------------------------------- admin ops
+
+    async def _snapshot_mgmt_async(self, req: RaftClientRequest
+                                   ) -> RaftClientReply:
+        """Client-triggered snapshot create
+        (SnapshotManagementRequestHandler): skip when the latest snapshot is
+        within the creation gap of the applied index."""
+        from ratis_tpu.protocol.admin import SnapshotManagementArguments
+        try:
+            args = SnapshotManagementArguments.from_payload(req.message.content)
+        except Exception as e:
+            return RaftClientReply.failure_reply(
+                req, RaftException(f"bad snapshotManagement payload: {e}"))
+        gap = args.creation_gap
+        if gap <= 0:
+            gap = self.server.properties.get_int(
+                RaftServerConfigKeys.Snapshot.CREATION_GAP_KEY,
+                RaftServerConfigKeys.Snapshot.CREATION_GAP_DEFAULT)
+        snap = self.state_machine.get_latest_snapshot()
+        if snap is not None and self._applied_index - snap.index < gap:
+            return RaftClientReply.success_reply(req, log_index=snap.index)
+        try:
+            index = await self.take_snapshot_async()
+        except Exception as e:
+            return RaftClientReply.failure_reply(
+                req, StateMachineException(str(e), cause=e))
+        return RaftClientReply.success_reply(req, log_index=index)
+
+    async def _election_mgmt_async(self, req: RaftClientRequest
+                                   ) -> RaftClientReply:
+        """Pause/resume this server's candidacy
+        (LeaderElectionManagementRequest; RaftServerImpl
+        leaderElectionManagementAsync:1285)."""
+        from ratis_tpu.protocol.admin import (LeaderElectionManagementArguments,
+                                              LeaderElectionManagementOp)
+        try:
+            args = LeaderElectionManagementArguments.from_payload(
+                req.message.content)
+        except Exception as e:
+            return RaftClientReply.failure_reply(
+                req, RaftException(f"bad leaderElectionManagement payload: {e}"))
+        if args.op == LeaderElectionManagementOp.PAUSE:
+            self._election_paused = True
+        else:
+            self._election_paused = False
+            self.reset_election_deadline()
+        return RaftClientReply.success_reply(req)
+
+    def _group_info(self, req: RaftClientRequest) -> RaftClientReply:
+        """GroupInfoRequest (reference GroupInfoReply + RoleInfoProto:537)."""
+        from ratis_tpu.protocol.admin import GroupInfoReplyData
+        conf = self.state.configuration
+        data = GroupInfoReplyData(
+            group=RaftGroup.value_of(self.group_id, conf.all_peers()),
+            role=self.role.name,
+            term=self.state.current_term,
+            leader_id=str(self.state.leader_id)
+            if self.state.leader_id is not None else None,
+            commit_index=self.state.log.get_last_committed_index(),
+            applied_index=self._applied_index,
+            is_leader_ready=(self.leader_ctx is not None
+                             and self.leader_ctx.leader_ready.done()))
+        return RaftClientReply.success_reply(
+            req, message=Message(data.to_payload()),
+            log_index=self._applied_index)
+
     # ----------------------------------------------------------- apply loop
 
     async def _apply_loop(self) -> None:
@@ -1037,6 +1256,7 @@ class Division:
                 await asyncio.to_thread(self.storage.persist_conf_entry, entry)
             await sm.notify_configuration_changed(
                 entry.term, entry.index, self.state.configuration)
+            await self._on_conf_entry_applied(entry)
         await sm.notify_term_index_updated(entry.term, entry.index)
 
         if self.is_leader() and self.leader_ctx is not None:

@@ -177,7 +177,12 @@ class RaftServer:
         if div is None:
             raise GroupMismatchException(f"{self.peer_id} does not host {group_id}")
         await div.state_machine.notify_group_remove()
+        storage = div.storage
         await div.close()
+        if delete_directory and storage is not None:
+            import shutil
+            await asyncio.to_thread(
+                shutil.rmtree, storage.root, ignore_errors=True)
 
     def get_division(self, group_id: RaftGroupId) -> Division:
         div = self.divisions.get(group_id)
@@ -208,6 +213,15 @@ class RaftServer:
 
     async def _handle_client_request(self, request: RaftClientRequest
                                      ) -> RaftClientReply:
+        from ratis_tpu.protocol.requests import RequestType
+        t = request.type.type
+        if t == RequestType.GROUP_MANAGEMENT:
+            return await self._group_management(request)
+        if t == RequestType.GROUP_LIST:
+            from ratis_tpu.protocol.admin import encode_group_list
+            from ratis_tpu.protocol.message import Message
+            return RaftClientReply.success_reply(
+                request, message=Message(encode_group_list(self.group_ids())))
         try:
             div = self.get_division(request.group_id)
         except GroupMismatchException as e:
@@ -219,6 +233,35 @@ class RaftServer:
         except Exception as e:  # never leak raw errors to the wire
             LOG.exception("%s request failed", self.peer_id)
             return RaftClientReply.failure_reply(request, RaftException(str(e)))
+
+    async def _group_management(self, request: RaftClientRequest
+                                ) -> RaftClientReply:
+        """GroupManagementApi server side (RaftServerProxy
+        groupManagementAsync:490 / groupAddAsync:509 / groupRemoveAsync:540)."""
+        from ratis_tpu.protocol.admin import (GroupManagementArguments,
+                                              GroupManagementOp)
+        try:
+            args = GroupManagementArguments.from_payload(request.message.content)
+        except Exception as e:
+            return RaftClientReply.failure_reply(
+                request, RaftException(f"bad groupManagement payload: {e}"))
+        try:
+            if args.op == GroupManagementOp.ADD:
+                if args.group is None:
+                    raise RaftException("group add without a group")
+                await self.group_add(args.group)
+            elif args.op == GroupManagementOp.REMOVE:
+                if args.group_id is None:
+                    raise RaftException("group remove without a group id")
+                await self.group_remove(args.group_id, args.delete_directory)
+            else:
+                raise RaftException(f"unknown group op {args.op}")
+        except RaftException as e:
+            return RaftClientReply.failure_reply(request, e)
+        except Exception as e:
+            LOG.exception("%s group management failed", self.peer_id)
+            return RaftClientReply.failure_reply(request, RaftException(str(e)))
+        return RaftClientReply.success_reply(request)
 
     async def send_server_rpc(self, to: RaftPeerId, msg):
         return await self.transport.send_server_rpc(to, msg)

@@ -144,6 +144,28 @@ class MiniCluster:
             f"applied index {index} not reached: "
             f"{[(str(d.member_id), d.applied_index) for d in divs]}")
 
+    def new_client(self, retry_policy=None, group: Optional[RaftGroup] = None):
+        """A full RaftClient bound to this cluster's transport."""
+        from ratis_tpu.client import RaftClient
+        return (RaftClient.builder()
+                .set_raft_group(group or self.group)
+                .set_transport(self.factory.new_client_transport())
+                .set_retry_policy(retry_policy)
+                .build())
+
+    async def add_new_server(self, peer: RaftPeer,
+                             group: Optional[RaftGroup] = None) -> RaftServer:
+        """Start a server that (by default) hosts no group yet — the
+        bootstrap target for group-add + setConfiguration staging."""
+        server = RaftServer(
+            peer.id, peer.address,
+            state_machine_registry=lambda gid: self.sm_factory(),
+            properties=self.properties, transport_factory=self.factory,
+            group=group, log_factory=self.log_factory)
+        self.servers[peer.id] = server
+        await server.start()
+        return server
+
     # -------------------------------------------------------------- client
 
     def _request(self, server_id: RaftPeerId, message: bytes,

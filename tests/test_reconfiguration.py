@@ -1,0 +1,290 @@
+"""Membership change, leadership transfer, and admin API tests.
+
+Models the reference suites RaftReconfigurationBaseTest,
+TestTransferLeadership (ratis-test), LeaderElectionTests pause/resume, and
+GroupManagement tests — over the simulated transport via the full
+RaftClient, like the reference drives them through RaftClient sub-APIs.
+"""
+
+import asyncio
+
+import pytest
+
+from minicluster import MiniCluster, fast_properties, run_with_new_cluster
+from ratis_tpu.protocol.admin import SetConfigurationMode
+from ratis_tpu.protocol.exceptions import RaftException
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer
+
+
+async def _wait(predicate, timeout=10.0, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError(f"{msg} not reached within {timeout}s")
+
+
+def test_client_write_read_failover():
+    """RaftClient finds the leader, writes, reads, survives leader kill."""
+
+    async def t(cluster: MiniCluster):
+        async with cluster.new_client() as client:
+            for _ in range(3):
+                r = await client.io().send(b"INCREMENT")
+                assert r.success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"3"
+            leader = await cluster.wait_for_leader()
+            await cluster.kill_server(leader.member_id.peer_id)
+            r = await client.io().send(b"INCREMENT")
+            assert r.success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"4"
+
+    run_with_new_cluster(3, t)
+
+
+def test_add_peers():
+    """3 -> 5 members via staging + joint consensus (ADD mode)."""
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for _ in range(5):
+                assert (await client.io().send(b"INCREMENT")).success
+
+            new_peers = [RaftPeer(RaftPeerId.value_of(f"x{i}"),
+                                  address=f"sim:x{i}") for i in range(2)]
+            empty_group = RaftGroup.value_of(cluster.group.group_id, [])
+            for p in new_peers:
+                await cluster.add_new_server(p)
+                r = await client.group_management().group_add(empty_group, p)
+                assert r.success, r
+
+            r = await client.admin().set_configuration(
+                new_peers, mode=SetConfigurationMode.ADD)
+            assert r.success, r
+
+            # all five see a stable 5-member conf and replicate writes
+            def stable_everywhere():
+                divs = cluster.divisions()
+                return (len(divs) == 5 and all(
+                    d.state.configuration.is_stable()
+                    and len(d.state.configuration.conf.peers) == 5
+                    for d in divs))
+            await _wait(stable_everywhere, msg="5-member conf everywhere")
+
+            assert (await client.io().send(b"INCREMENT")).success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"6"
+            await cluster.wait_applied(r.log_index)
+            for d in cluster.divisions():
+                assert d.state.configuration.is_stable()
+
+    run_with_new_cluster(3, t)
+
+
+def test_remove_peer_and_survive():
+    """5 -> 3: removed peers stop voting; cluster keeps committing."""
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        keep = [p for p in cluster.group.peers
+                if p.id == leader.member_id.peer_id][:1]
+        keep += [p for p in cluster.group.peers
+                 if p.id != leader.member_id.peer_id][:2]
+        async with cluster.new_client() as client:
+            r = await client.admin().set_configuration(keep)
+            assert r.success, r
+            await _wait(lambda: leader.state.configuration.is_stable()
+                        and len(leader.state.configuration.conf.peers) == 3,
+                        msg="3-member conf on leader")
+            assert (await client.io().send(b"INCREMENT")).success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"1"
+            # removed peers are no longer voting members
+            kept_ids = {p.id for p in keep}
+            for d in cluster.divisions():
+                if d.member_id.peer_id not in kept_ids:
+                    assert not d.state.configuration.contains_voting(
+                        d.member_id.peer_id)
+
+    run_with_new_cluster(5, t)
+
+
+def test_remove_leader_steps_down():
+    """Removing the leader commits the conf, then the leader steps down and
+    a remaining member takes over (reference yield-on-removal)."""
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        remaining = [p for p in cluster.group.peers
+                     if p.id != leader.member_id.peer_id]
+        async with cluster.new_client() as client:
+            r = await client.admin().set_configuration(remaining)
+            assert r.success, r
+            ids = {p.id for p in remaining}
+            await _wait(lambda: any(d.is_leader()
+                                    and d.member_id.peer_id in ids
+                                    for d in cluster.divisions()),
+                        msg="new leader among remaining members")
+            assert (await client.io().send(b"INCREMENT")).success
+
+    run_with_new_cluster(3, t)
+
+
+def test_promote_listener_and_demote_voter():
+    """Moving members between the voting set and the listener set flips
+    Division roles: a demoted voter stops campaigning (listener), a promoted
+    listener starts voting."""
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        divs = {d.member_id.peer_id: d for d in cluster.divisions()}
+        voters = [p for p in cluster.group.peers]
+        listener_div = next(d for d in divs.values() if d.is_listener())
+        listener_peer = next(p for p in cluster.group.peers
+                             if p.id == listener_div.member_id.peer_id)
+        demote_div = next(d for d in divs.values()
+                          if not d.is_leader() and not d.is_listener())
+        demote_peer = next(p for p in voters
+                           if p.id == demote_div.member_id.peer_id)
+
+        new_voting = [p for p in voters if p.id != demote_peer.id
+                      and p.id != listener_peer.id] + [listener_peer]
+        async with cluster.new_client() as client:
+            r = await client.admin().set_configuration(
+                new_voting, listeners=[demote_peer])
+            assert r.success, r
+            await _wait(lambda: listener_div.is_follower()
+                        or listener_div.is_leader(),
+                        msg="promoted listener becomes voting")
+            await _wait(lambda: demote_div.is_listener(),
+                        msg="demoted voter becomes listener")
+            # promoted member now grants votes / can campaign; cluster works
+            assert (await client.io().send(b"INCREMENT")).success
+
+    run_with_new_cluster(3, t, num_listeners=1)
+
+
+def test_compare_and_set_precondition():
+    async def t(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            wrong = [RaftPeer(RaftPeerId.value_of("ghost"), address="sim:g")]
+            r = await client.admin().set_configuration(
+                list(cluster.group.peers)[:2],
+                mode=SetConfigurationMode.COMPARE_AND_SET,
+                current_peers=wrong)
+            assert not r.success
+            assert "COMPARE_AND_SET" in str(r.exception)
+
+    run_with_new_cluster(3, t)
+
+
+def test_reject_concurrent_reconfiguration():
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        from ratis_tpu.server import admin as server_admin
+        # hold the single-flight slot and verify a second request bounces
+        leader.pending_reconf = server_admin.PendingReconf()
+        try:
+            async with cluster.new_client() as client:
+                r = await client.admin().set_configuration(
+                    list(cluster.group.peers)[:2], timeout_ms=2000.0)
+                assert not r.success
+                assert "in progress" in str(r.exception)
+        finally:
+            leader.pending_reconf = None
+
+    run_with_new_cluster(3, t)
+
+
+def test_transfer_leadership():
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        target = next(p for p in cluster.group.peers
+                      if p.id != leader.member_id.peer_id)
+        async with cluster.new_client() as client:
+            r = await client.admin().transfer_leadership(target.id,
+                                                         timeout_ms=5000.0)
+            assert r.success, r
+            await _wait(lambda: any(d.is_leader()
+                                    and d.member_id.peer_id == target.id
+                                    for d in cluster.divisions()),
+                        msg=f"{target.id} leads")
+            # old leader stepped down and writes still work
+            assert (await client.io().send(b"INCREMENT")).success
+
+    run_with_new_cluster(3, t)
+
+
+def test_election_pause_resume():
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        followers = [d for d in cluster.divisions() if not d.is_leader()]
+        paused = followers[0]
+        async with cluster.new_client() as client:
+            r = await client.leader_election_management().pause(
+                paused.member_id.peer_id)
+            assert r.success
+            await cluster.kill_server(leader.member_id.peer_id)
+            new_leader = await cluster.wait_for_leader()
+            # the paused follower may vote but must not have become leader
+            assert new_leader.member_id.peer_id != paused.member_id.peer_id
+            r = await client.leader_election_management().resume(
+                paused.member_id.peer_id)
+            assert r.success
+
+    run_with_new_cluster(3, t)
+
+
+def test_group_management_and_info():
+    async def t(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            any_server = next(iter(cluster.servers))
+            groups = await client.group_management().group_list(any_server)
+            assert cluster.group.group_id in groups
+
+            info = await client.group_management().group_info(any_server)
+            assert info.group.group_id == cluster.group.group_id
+            assert info.term >= 1
+            assert {p.id for p in info.group.peers} \
+                == {p.id for p in cluster.group.peers}
+
+            # add + remove a second group on one server
+            g2 = RaftGroup.value_of(
+                RaftGroupId.random_id(),
+                [RaftPeer(any_server, address=f"sim:{any_server}")])
+            r = await client.group_management().group_add(g2, any_server)
+            assert r.success, r
+            groups = await client.group_management().group_list(any_server)
+            assert g2.group_id in groups
+            r = await client.group_management().group_remove(
+                g2.group_id, any_server)
+            assert r.success, r
+            groups = await client.group_management().group_list(any_server)
+            assert g2.group_id not in groups
+
+    run_with_new_cluster(3, t)
+
+
+def test_snapshot_management_create(tmp_path):
+    async def t(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for _ in range(4):
+                assert (await client.io().send(b"INCREMENT")).success
+            leader = await cluster.wait_for_leader()
+            r = await client.snapshot_management().create(
+                creation_gap=1, server_id=leader.member_id.peer_id)
+            assert r.success, r
+            assert r.log_index >= 4
+            snap = leader.state_machine.get_latest_snapshot()
+            assert snap is not None and snap.index == r.log_index
+
+    run_with_new_cluster(3, t, storage_root=str(tmp_path))
