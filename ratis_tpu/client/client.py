@@ -187,7 +187,6 @@ class RaftClient:
         RaftClientImpl.handleIOException)."""
         req = self._new_request(message, type_case, server_id, timeout_ms,
                                 group_id)
-        attempt = 0
         sticky = server_id is not None  # explicit target: no failover
         try:
             return await self._retry_loop(req, sticky)

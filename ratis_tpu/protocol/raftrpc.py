@@ -49,16 +49,22 @@ class RequestVoteRequest:
     candidate_term: int
     candidate_last_entry: TermIndex
     pre_vote: bool = False
+    # Leadership-transfer election (startLeaderElection target): voters skip
+    # the live-leader stickiness check, as the transfer was initiated by the
+    # current leader itself (Raft §3.10 TimeoutNow semantics).
+    force: bool = False
 
     def to_dict(self) -> dict:
         return {"h": self.header.to_dict(), "t": self.candidate_term,
                 "lt": self.candidate_last_entry.term,
-                "li": self.candidate_last_entry.index, "pv": self.pre_vote}
+                "li": self.candidate_last_entry.index, "pv": self.pre_vote,
+                "f": self.force}
 
     @staticmethod
     def from_dict(d: dict) -> "RequestVoteRequest":
         return RequestVoteRequest(RaftRpcHeader.from_dict(d["h"]), d["t"],
-                                  TermIndex(d["lt"], d["li"]), d.get("pv", False))
+                                  TermIndex(d["lt"], d["li"]),
+                                  d.get("pv", False), d.get("f", False))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -230,7 +230,7 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
         my_priority = me.priority if me is not None else 0
         candidates = [p for p in conf.voting_peers()
                       if p.id != div.member_id.peer_id
-                      and p.priority >= my_priority]
+                      and p.priority > my_priority]
         if not candidates:
             return RaftClientReply.failure_reply(
                 req, TransferLeadershipException(
@@ -242,34 +242,37 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
     deadline = asyncio.get_event_loop().time() + timeout_s
     div.stepping_down = True
     try:
-        # 1. wait for the target to be fully caught up (match == our last)
-        sent = False
+        # 1. wait for the target to be fully caught up (match == our last);
+        # 2. fire the forced election on it (re-firing if it loses a round);
+        # 3. succeed only once the TARGET is the known leader (reference
+        #    TransferLeadership completes on the matching leader event).
+        last_sent = -1.0
         while asyncio.get_event_loop().time() < deadline:
             if not div.is_leader():
-                # handover happened (we saw the new term)
-                return RaftClientReply.success_reply(req)
+                if div.state.leader_id == target_id:
+                    return RaftClientReply.success_reply(req)
+                await asyncio.sleep(0.02)  # some other peer won; keep waiting
+                continue
             ctx = div.leader_ctx
             f = ctx.followers.get(target_id) if ctx is not None else None
             last = state.log.next_index - 1
-            if f is not None and f.match_index >= last and not sent:
-                # 2. fire the forced election on the target
+            now = asyncio.get_event_loop().time()
+            if f is not None and f.match_index >= last \
+                    and now - last_sent > 0.3:
+                last_sent = now
                 hdr = RaftRpcHeader(div.member_id.peer_id, target_id,
                                     div.group_id)
                 last_ti = state.log.get_last_entry_term_index()
                 try:
-                    reply = await div.server.send_server_rpc(
-                        target_id,
-                        StartLeaderElectionRequest(hdr, last_ti))
-                    sent = bool(getattr(reply, "accepted", False))
+                    await div.server.send_server_rpc(
+                        target_id, StartLeaderElectionRequest(hdr, last_ti))
                 except Exception as e:
                     LOG.warning("%s startLeaderElection to %s failed: %s",
                                 div.member_id, target_id, e)
-                if not sent:
-                    await asyncio.sleep(0.05)
-                continue
             await asyncio.sleep(0.02)
         return RaftClientReply.failure_reply(
             req, TransferLeadershipException(
-                f"transfer to {target_id} timed out after {timeout_s}s"))
+                f"transfer to {target_id} timed out after {timeout_s}s "
+                f"(leader now {div.state.leader_id})"))
     finally:
         div.stepping_down = False

@@ -235,6 +235,7 @@ class Division:
     def _sync_conf_to_engine(self) -> None:
         import numpy as np
         conf = self.state.configuration
+        self.server.learn_peer_addresses(conf.all_peers())
         n = self.max_peers
         cur = np.zeros(n, bool)
         old = np.zeros(n, bool)
@@ -480,7 +481,7 @@ class Division:
                            and state.leader_id != candidate
                            and (loop_now - self._last_heard_leader_s)
                            < self._timeout_min_s)
-        if has_live_leader:
+        if has_live_leader and not req.force:
             return reply(False, state.current_term)
 
         if req.pre_vote:
@@ -765,6 +766,7 @@ class Division:
         """Bootstrap a brand-new member before it enters the conf
         (LeaderStateImpl BootStrapProgress / addSenders for staging)."""
         assert self.leader_ctx is not None
+        self.server.learn_peer_addresses([peer])
         self.leader_ctx.add_follower(peer.id, self.state.log.next_index)
 
     async def remove_staged_peer(self, peer_id: RaftPeerId) -> None:

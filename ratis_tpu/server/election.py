@@ -126,7 +126,8 @@ class LeaderElection:
         header = lambda to: RaftRpcHeader(div.member_id.peer_id, to.id,
                                           div.group_id)
         request = lambda to: RequestVoteRequest(
-            header(to), term, last, pre_vote=(phase == Phase.PRE_VOTE))
+            header(to), term, last, pre_vote=(phase == Phase.PRE_VOTE),
+            force=self.force)
 
         queue: asyncio.Queue = asyncio.Queue()
 

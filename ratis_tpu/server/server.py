@@ -66,9 +66,18 @@ class RaftServer:
                 RaftServerConfigKeys.Engine.SCALAR_FALLBACK_THRESHOLD_DEFAULT),
             leadership_timeout_ms=int(
                 RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2))
+        # peer id -> network address, fed from every conf the server sees
+        # (division conf syncs, staging, group adds); the resolver transports
+        # dial by (reference PeerProxyMap's address source).
+        self.peer_addresses: dict[RaftPeerId, str] = {}
+        if group is not None:
+            for peer in group.peers:
+                if peer.address:
+                    self.peer_addresses[peer.id] = peer.address
         self.transport: ServerTransport = transport_factory.new_server_transport(
             peer_id, address, self._handle_server_rpc,
-            self._handle_client_request, properties)
+            self._handle_client_request, properties,
+            peer_resolver=self.resolve_peer_address)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -262,6 +271,14 @@ class RaftServer:
             LOG.exception("%s group management failed", self.peer_id)
             return RaftClientReply.failure_reply(request, RaftException(str(e)))
         return RaftClientReply.success_reply(request)
+
+    def resolve_peer_address(self, peer_id: RaftPeerId) -> Optional[str]:
+        return self.peer_addresses.get(peer_id)
+
+    def learn_peer_addresses(self, peers) -> None:
+        for p in peers:
+            if p.address:
+                self.peer_addresses[p.id] = p.address
 
     async def send_server_rpc(self, to: RaftPeerId, msg):
         return await self.transport.send_server_rpc(to, msg)

@@ -71,7 +71,10 @@ class TransportFactory:
     def new_server_transport(self, peer_id: RaftPeerId, address: str,
                              server_handler: ServerRpcHandler,
                              client_handler: ClientRequestHandler,
-                             properties=None) -> ServerTransport:
+                             properties=None,
+                             peer_resolver=None) -> ServerTransport:
+        """peer_resolver: RaftPeerId -> address | None, for transports that
+        dial peers by network address (the simulated hub routes by id)."""
         raise NotImplementedError
 
     def new_client_transport(self, properties=None) -> ClientTransport:

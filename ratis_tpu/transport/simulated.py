@@ -164,7 +164,8 @@ class SimulatedTransportFactory(TransportFactory):
         self.network = network or SimulatedNetwork()
 
     def new_server_transport(self, peer_id, address, server_handler,
-                             client_handler, properties=None) -> ServerTransport:
+                             client_handler, properties=None,
+                             peer_resolver=None) -> ServerTransport:
         return SimulatedServerTransport(self.network, peer_id, address,
                                         server_handler, client_handler)
 

@@ -42,17 +42,32 @@ def fast_properties() -> RaftProperties:
     return p
 
 
+def free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 class MiniCluster:
     def __init__(self, num_servers: int = 3, num_listeners: int = 0,
                  properties: Optional[RaftProperties] = None,
                  sm_factory: Callable[[], StateMachine] = CounterStateMachine,
-                 log_factory=None, storage_root: Optional[str] = None):
+                 log_factory=None, storage_root: Optional[str] = None,
+                 rpc_type: str = "SIMULATED"):
         self.properties = (properties or fast_properties()).clone()
         self.storage_root = storage_root
         if storage_root is not None:
             RaftServerConfigKeys.Log.set_use_memory(self.properties, False)
-        self.network = SimulatedNetwork()
-        self.factory = SimulatedTransportFactory(self.network)
+        self.rpc_type = rpc_type.upper()
+        if self.rpc_type == "GRPC":
+            from ratis_tpu.transport import grpc as grpc_transport  # registers
+            from ratis_tpu.transport.base import TransportFactory
+            self.network = None
+            self.factory = TransportFactory.get("GRPC")
+        else:
+            self.network = SimulatedNetwork()
+            self.factory = SimulatedTransportFactory(self.network)
         self.sm_factory = sm_factory
         self.log_factory = log_factory
 
@@ -60,8 +75,10 @@ class MiniCluster:
         for i in range(num_servers + num_listeners):
             role = (RaftPeerRole.LISTENER if i >= num_servers
                     else RaftPeerRole.FOLLOWER)
+            address = (f"127.0.0.1:{free_port()}" if self.rpc_type == "GRPC"
+                       else f"sim:s{i}")
             peers.append(RaftPeer(RaftPeerId.value_of(f"s{i}"),
-                                  address=f"sim:s{i}", startup_role=role))
+                                  address=address, startup_role=role))
         self.group = RaftGroup.value_of(RaftGroupId.random_id(), peers)
         self.servers: dict[RaftPeerId, RaftServer] = {}
         self._stopped: dict[RaftPeerId, RaftPeer] = {}

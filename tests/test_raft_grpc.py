@@ -1,0 +1,103 @@
+"""Core Raft scenarios over the real gRPC transport.
+
+Mirrors the reference pattern of instantiating abstract suites per transport
+(ratis-test TestRaftWithGrpc etc.): the same behaviors the simulated-rpc
+tests cover, driven over real localhost sockets through grpc.aio.
+"""
+
+import asyncio
+
+from minicluster import MiniCluster, free_port, run_with_new_cluster
+from ratis_tpu.protocol.admin import SetConfigurationMode
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer
+
+
+def test_grpc_write_read():
+    async def t(cluster: MiniCluster):
+        async with cluster.new_client() as client:
+            for _ in range(5):
+                assert (await client.io().send(b"INCREMENT")).success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"5"
+
+    run_with_new_cluster(3, t, rpc_type="GRPC")
+
+
+def test_grpc_leader_kill_failover():
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            assert (await client.io().send(b"INCREMENT")).success
+            await cluster.kill_server(leader.member_id.peer_id)
+            await cluster.wait_for_leader()
+            assert (await client.io().send(b"INCREMENT")).success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"2"
+
+    run_with_new_cluster(3, t, rpc_type="GRPC")
+
+
+def test_grpc_restart_rejoins():
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        victim = next(d for d in cluster.divisions() if not d.is_leader())
+        vid = victim.member_id.peer_id
+        async with cluster.new_client() as client:
+            assert (await client.io().send(b"INCREMENT")).success
+            await cluster.kill_server(vid)
+            assert (await client.io().send(b"INCREMENT")).success
+            await cluster.restart_server(vid)
+            r = await client.io().send(b"INCREMENT")
+            assert r.success
+            await cluster.wait_applied(r.log_index)
+
+    run_with_new_cluster(3, t, rpc_type="GRPC")
+
+
+def test_grpc_add_peer_and_transfer():
+    async def t(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            assert (await client.io().send(b"INCREMENT")).success
+            p = RaftPeer(RaftPeerId.value_of("g1"),
+                         address=f"127.0.0.1:{free_port()}")
+            await cluster.add_new_server(p)
+            empty = RaftGroup.value_of(cluster.group.group_id, [])
+            assert (await client.group_management().group_add(empty, p)).success
+            r = await client.admin().set_configuration(
+                [p], mode=SetConfigurationMode.ADD)
+            assert r.success, r
+            r = await client.admin().transfer_leadership(p.id,
+                                                         timeout_ms=8000.0)
+            assert r.success, r
+            assert (await client.io().send(b"INCREMENT")).success
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while True:
+                info = await client.group_management().group_info(p)
+                if info.role == "LEADER":
+                    break
+                assert asyncio.get_event_loop().time() < deadline, info
+                await asyncio.sleep(0.05)
+
+    run_with_new_cluster(3, t, rpc_type="GRPC")
+
+
+def test_grpc_watch_and_stale_read():
+    async def t(cluster: MiniCluster):
+        from ratis_tpu.protocol.requests import ReplicationLevel
+        leader = await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            r = await client.io().send(b"INCREMENT")
+            assert r.success
+            w = await client.io().watch(r.log_index, ReplicationLevel.ALL)
+            assert w.success
+            await cluster.wait_applied(r.log_index)
+            follower = next(d for d in cluster.divisions()
+                            if not d.is_leader())
+            sr = await client.io().send_stale_read(
+                b"GET", r.log_index, follower.member_id.peer_id)
+            assert sr.success and sr.message.content == b"1"
+
+    run_with_new_cluster(3, t, rpc_type="GRPC")
