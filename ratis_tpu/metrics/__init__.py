@@ -1,0 +1,44 @@
+"""Metrics subsystem (reference ratis-metrics-api / ratis-metrics-default).
+
+Registry core in :mod:`ratis_tpu.metrics.registry`; per-division facades in
+:mod:`ratis_tpu.metrics.server_metrics`; a periodic console reporter in
+:func:`start_console_reporter` (MetricsReporting.java:34-61 analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+
+from ratis_tpu.metrics.registry import (Counter, MetricRegistries,
+                                        MetricRegistryInfo,
+                                        RatisMetricRegistry, Timekeeper)
+from ratis_tpu.metrics.server_metrics import (LeaderElectionMetrics,
+                                              LogAppenderMetrics,
+                                              LogWorkerMetrics,
+                                              RaftServerMetrics,
+                                              SegmentedRaftLogMetrics,
+                                              StateMachineMetrics)
+
+__all__ = [
+    "Counter", "MetricRegistries", "MetricRegistryInfo",
+    "RatisMetricRegistry", "Timekeeper", "RaftServerMetrics",
+    "LeaderElectionMetrics", "SegmentedRaftLogMetrics", "LogWorkerMetrics",
+    "LogAppenderMetrics", "StateMachineMetrics", "start_console_reporter",
+]
+
+
+def start_console_reporter(period_s: float = 60.0,
+                           stream=None) -> asyncio.Task:
+    """Periodically dump every registry snapshot as JSON lines
+    (console-reporter analog; cancel the returned task to stop)."""
+    out = stream or sys.stderr
+
+    async def _report_loop():
+        regs = MetricRegistries.global_registries()
+        while True:
+            await asyncio.sleep(period_s)
+            print(json.dumps(regs.snapshot_all(), default=str), file=out)
+
+    return asyncio.create_task(_report_loop(), name="metrics-reporter")

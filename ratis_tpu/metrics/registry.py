@@ -1,0 +1,243 @@
+"""Metrics registry: counters, gauges, timers with 3-level naming.
+
+Capability parity with the reference metrics SPI
+(ratis-metrics-api/src/main/java/org/apache/ratis/metrics/):
+``MetricRegistryInfo`` (app/component/name 3-level naming),
+``RatisMetricRegistry`` (counter/gauge/timer accessors),
+``Timekeeper`` (timer contexts), and the ``MetricRegistries`` process-global
+singleton that creates/removes registries and serves reporters (the
+reference discovers the implementation via ServiceLoader,
+MetricRegistries.java; here the in-process implementation is direct).
+
+TPU-first note: metrics are plain host-side Python — they observe the
+asyncio runtime and kernel-dispatch cadence, never device code.  Timers
+keep a bounded reservoir so p50/p99 snapshots are O(1) memory, matching
+what the dropwizard histogram gives the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricRegistryInfo:
+    """3-level metric naming (MetricRegistryInfo.java): app.component.name."""
+
+    prefix: str          # e.g. a group-member id ("s0@group-1234")
+    application: str     # "ratis"
+    component: str       # "server", "log_worker", "leader_election", ...
+    name: str            # metrics class name
+
+    @property
+    def full_name(self) -> str:
+        return ".".join((self.application, self.component, self.prefix,
+                         self.name))
+
+
+class Counter:
+    """Monotonic (but resettable) counter."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: int = 1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def count(self) -> int:
+        return self._value
+
+
+class Timekeeper:
+    """Timer with count/total and a bounded reservoir for percentiles
+    (reference Timekeeper + dropwizard Timer)."""
+
+    RESERVOIR = 512
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total_s = 0.0
+        self._max_s = 0.0
+        self._samples: list[float] = []
+
+    class Context:
+        __slots__ = ("_timer", "_start")
+
+        def __init__(self, timer: "Timekeeper") -> None:
+            self._timer = timer
+            self._start = time.perf_counter()
+
+        def stop(self) -> float:
+            elapsed = time.perf_counter() - self._start
+            self._timer.update(elapsed)
+            return elapsed
+
+        def __enter__(self) -> "Timekeeper.Context":
+            return self
+
+        def __exit__(self, *exc) -> None:
+            self.stop()
+
+    def time(self) -> "Timekeeper.Context":
+        return Timekeeper.Context(self)
+
+    def update(self, elapsed_s: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total_s += elapsed_s
+            self._max_s = max(self._max_s, elapsed_s)
+            if len(self._samples) < self.RESERVOIR:
+                self._samples.append(elapsed_s)
+            else:  # Vitter's algorithm R — uniform over the stream
+                import random
+                j = random.randrange(self._count)
+                if j < self.RESERVOIR:
+                    self._samples[j] = elapsed_s
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean_s(self) -> float:
+        return self._total_s / self._count if self._count else 0.0
+
+    def percentile_s(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            ordered = sorted(self._samples)
+            idx = min(len(ordered) - 1, int(q * len(ordered)))
+            return ordered[idx]
+
+    def snapshot(self) -> dict:
+        return {"count": self._count, "mean_s": self.mean_s,
+                "max_s": self._max_s, "p50_s": self.percentile_s(0.50),
+                "p99_s": self.percentile_s(0.99)}
+
+
+class RatisMetricRegistry:
+    """One named registry of counters/gauges/timers
+    (RatisMetricRegistry.java / impl/RatisMetricRegistryImpl.java)."""
+
+    def __init__(self, info: MetricRegistryInfo) -> None:
+        self.info = info
+        self._counters: Dict[str, Counter] = {}
+        self._timers: Dict[str, Timekeeper] = {}
+        self._gauges: Dict[str, Callable[[], object]] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def timer(self, name: str) -> Timekeeper:
+        with self._lock:
+            return self._timers.setdefault(name, Timekeeper())
+
+    def gauge(self, name: str, supplier: Callable[[], object]) -> None:
+        with self._lock:
+            self._gauges[name] = supplier
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            return (self._counters.pop(name, None) is not None
+                    or self._timers.pop(name, None) is not None
+                    or self._gauges.pop(name, None) is not None)
+
+    def metric_names(self) -> list[str]:
+        with self._lock:
+            return sorted([*self._counters, *self._timers, *self._gauges])
+
+    def snapshot(self) -> dict:
+        """Flat {metric: value} view (console/JMX reporter analog)."""
+        out: dict = {}
+        with self._lock:
+            counters = dict(self._counters)
+            timers = dict(self._timers)
+            gauges = dict(self._gauges)
+        for name, c in counters.items():
+            out[name] = c.count
+        for name, t in timers.items():
+            out[name] = t.snapshot()
+        for name, g in gauges.items():
+            try:
+                out[name] = g()
+            except Exception as e:  # gauge suppliers must never break reports
+                out[name] = f"<error: {e}>"
+        return out
+
+
+class MetricRegistries:
+    """Process-global registry-of-registries (MetricRegistries.global())."""
+
+    _global: Optional["MetricRegistries"] = None
+    _global_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._registries: Dict[MetricRegistryInfo, RatisMetricRegistry] = {}
+        self._lock = threading.Lock()
+        self._reporters: list[Callable[[RatisMetricRegistry], None]] = []
+        self._stop_reporters: list[Callable[[RatisMetricRegistry], None]] = []
+
+    @classmethod
+    def global_registries(cls) -> "MetricRegistries":
+        with cls._global_lock:
+            if cls._global is None:
+                cls._global = MetricRegistries()
+            return cls._global
+
+    def create(self, info: MetricRegistryInfo) -> RatisMetricRegistry:
+        with self._lock:
+            reg = self._registries.get(info)
+            if reg is None:
+                reg = RatisMetricRegistry(info)
+                self._registries[info] = reg
+                for reporter in self._reporters:
+                    reporter(reg)
+            return reg
+
+    def remove(self, info: MetricRegistryInfo) -> bool:
+        with self._lock:
+            reg = self._registries.pop(info, None)
+            if reg is not None:
+                for stop in self._stop_reporters:
+                    stop(reg)
+            return reg is not None
+
+    def get(self, info: MetricRegistryInfo) -> Optional[RatisMetricRegistry]:
+        with self._lock:
+            return self._registries.get(info)
+
+    def get_registry_infos(self) -> Iterable[MetricRegistryInfo]:
+        with self._lock:
+            return list(self._registries)
+
+    def add_reporter_registration(
+            self, reporter: Callable[[RatisMetricRegistry], None],
+            stop_reporter: Callable[[RatisMetricRegistry], None]) -> None:
+        with self._lock:
+            self._reporters.append(reporter)
+            self._stop_reporters.append(stop_reporter)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._registries.clear()
+
+    def snapshot_all(self) -> dict:
+        with self._lock:
+            regs = dict(self._registries)
+        return {info.full_name: reg.snapshot() for info, reg in regs.items()}

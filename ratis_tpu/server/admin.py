@@ -206,6 +206,7 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
     err = div._check_leader(req)
     if err is not None:
         return err
+    div.election_metrics.transfer_count.inc()
     try:
         args = TransferLeadershipArguments.from_payload(req.message.content)
     except Exception as e:

@@ -143,6 +143,20 @@ class Division:
         self.stepping_down = False  # transfer-leadership in progress
         self._election_paused = False
 
+        # metrics (reference RaftServerMetricsImpl / LeaderElectionMetrics /
+        # StateMachineMetrics; catalog in ratis-docs metrics.md)
+        from ratis_tpu.metrics import (LeaderElectionMetrics,
+                                       RaftServerMetrics, StateMachineMetrics)
+        self.metrics = RaftServerMetrics(self.member_id)
+        self.election_metrics = LeaderElectionMetrics(self.member_id)
+        self.sm_metrics = StateMachineMetrics(self.member_id)
+        self.sm_metrics.add_applied_index_gauge(lambda: self._applied_index)
+        self.metrics.add_commit_info_gauge(
+            lambda: {"commitIndex": self.state.log.get_last_committed_index(),
+                     "appliedIndex": self._applied_index})
+        self.metrics.add_queue_gauge(
+            lambda: len(self.leader_ctx.pending) if self.leader_ctx else 0)
+
     # ------------------------------------------------------------------ util
 
     def is_leader(self) -> bool:
@@ -334,10 +348,15 @@ class Division:
             except asyncio.CancelledError:
                 pass
         self.detach_engine()
-        await self.state.log.close()
-        await self.state_machine.close()
-        if self.storage is not None:
-            self.storage.unlock()
+        try:
+            await self.state.log.close()
+            await self.state_machine.close()
+        finally:
+            self.metrics.unregister()
+            self.election_metrics.unregister()
+            self.sm_metrics.unregister()
+            if self.storage is not None:
+                self.storage.unlock()
 
     # -------------------------------------------------- EngineListener API
 
@@ -349,6 +368,7 @@ class Division:
                     self.member_id.peer_id):
             self.reset_election_deadline()
             return
+        self.election_metrics.timeout_count.inc()
         await self.change_to_candidate()
 
     async def on_commit_advance(self, new_commit: int) -> None:
@@ -394,6 +414,7 @@ class Division:
     async def change_to_leader(self) -> None:
         assert self.is_candidate()
         self.role = RaftPeerRole.LEADER
+        self.election_metrics.on_new_leader_elected()
         self.state.set_leader(self.member_id.peer_id)
         self._engine_set_role(ROLE_LEADER)
         st = self.server.engine.state
@@ -503,6 +524,11 @@ class Division:
 
     async def handle_append_entries(self, req: AppendEntriesRequest
                                     ) -> AppendEntriesReply:
+        with self.metrics.follower_append_timer.time():
+            return await self._handle_append_entries_impl(req)
+
+    async def _handle_append_entries_impl(self, req: AppendEntriesRequest
+                                          ) -> AppendEntriesReply:
         await injection.execute(injection.APPEND_ENTRIES, self.member_id,
                                 req.header.requestor_id)
         state = self.state
@@ -671,7 +697,8 @@ class Division:
             return self._last_snapshot_index
         self._taking_snapshot = True
         try:
-            index = await self.state_machine.take_snapshot()
+            with self.sm_metrics.snapshot_timer.time():
+                index = await self.state_machine.take_snapshot()
             if index < 0:
                 return index
             self._last_snapshot_index = index
@@ -836,6 +863,7 @@ class Division:
     # ------------------------------------------------------- client path
 
     async def submit_client_request(self, req: RaftClientRequest) -> RaftClientReply:
+        self.metrics.num_requests.inc()
         if req.replied_call_ids:
             # piggybacked retry-cache GC (RaftClientImpl.RepliedCallIds)
             self.retry_cache.evict_replied(req.client_id.to_bytes(),
@@ -896,14 +924,19 @@ class Division:
             cache_entry, is_new = self.retry_cache.get_or_create(
                 req.client_id.to_bytes(), req.call_id)
             if is_new:
+                self.metrics.retry_cache_miss.inc()
                 break
+            self.metrics.retry_cache_hit.inc()
             try:
                 return await asyncio.shield(cache_entry.future)
             except asyncio.CancelledError:
                 if not cache_entry.future.cancelled():
                     raise  # our caller was cancelled, not the entry
 
-        reply = await self._write_impl(req)
+        with self.metrics.write_timer.time():
+            reply = await self._write_impl(req)
+        if not reply.success:
+            self.metrics.num_failed.inc()
         if reply.success:
             cache_entry.complete(reply)
             self.write_index_cache.put(req.client_id.to_bytes(),
@@ -944,6 +977,10 @@ class Division:
         return await pending.future
 
     async def _read_async(self, req: RaftClientRequest) -> RaftClientReply:
+        with self.metrics.read_timer.time():
+            return await self._read_async_impl(req)
+
+    async def _read_async_impl(self, req: RaftClientRequest) -> RaftClientReply:
         from ratis_tpu.protocol.exceptions import ReadException, ReadIndexException
         linearizable = (self.read_option ==
                         RaftServerConfigKeys.Read.Option.LINEARIZABLE
@@ -1094,8 +1131,10 @@ class Division:
         if err is not None:
             return err
         try:
-            frontier = await self.watch_requests.watch(
-                req.type.watch_index, req.type.watch_replication, req.call_id)
+            with self.metrics.watch_timer.time():
+                frontier = await self.watch_requests.watch(
+                    req.type.watch_index, req.type.watch_replication,
+                    req.call_id)
         except RaftException as e:
             return RaftClientReply.failure_reply(req, e)
         return RaftClientReply.success_reply(req, log_index=frontier)
@@ -1238,6 +1277,7 @@ class Division:
                 trx = TransactionContext(log_entry=entry)
             try:
                 reply_message = await sm.apply_transaction(trx)
+                self.sm_metrics.applied_count.inc()
             except Exception as e:
                 exception = StateMachineException(str(e), cause=e)
             # Populate the retry cache on EVERY role at apply time so a

@@ -61,6 +61,15 @@ class LeaderElection:
             LOG.debug("%s not in conf, skip election", div.member_id)
             div.reset_election_deadline()
             return
+        div.election_metrics.election_count.inc()
+        election_ctx = div.election_metrics.election_timer.time()
+        try:
+            await self._run_phases()
+        finally:
+            election_ctx.stop()
+
+    async def _run_phases(self) -> None:
+        div = self.division
 
         if div.pre_vote_enabled and not self.force:
             result, _ = await self._ask_for_votes(Phase.PRE_VOTE)

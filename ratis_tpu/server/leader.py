@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 from ratis_tpu.protocol.exceptions import (NotLeaderException,
@@ -97,8 +98,10 @@ class FollowerInfo:
         self.commit_index = -1  # piggybacked on append replies
         self.snapshot_in_progress = False
         self.attend_vote = True  # False for listeners
+        self.last_rpc_response_s = time.monotonic()
 
     def update_match(self, match: int) -> bool:
+        self.last_rpc_response_s = time.monotonic()
         if match > self.match_index:
             self.match_index = match
             return True
@@ -242,6 +245,8 @@ class LeaderContext:
         self._heartbeat_interval_s = hb
         self._buffer_byte_limit = \
             RaftServerConfigKeys.Log.Appender.buffer_byte_limit(p)
+        from ratis_tpu.metrics import LogAppenderMetrics
+        self.appender_metrics = LogAppenderMetrics(division.member_id)
 
     def start_appenders(self) -> None:
         div = self.division
@@ -259,10 +264,15 @@ class LeaderContext:
         appender = LogAppender(self.division, info, self._heartbeat_interval_s,
                                self._buffer_byte_limit)
         self.appenders[peer_id] = appender
+        self.appender_metrics.add_follower_gauges(
+            peer_id, lambda i=info: i.next_index,
+            lambda i=info: i.match_index,
+            lambda i=info: time.monotonic() - i.last_rpc_response_s)
         appender.start()
 
     async def remove_follower(self, peer_id: RaftPeerId) -> None:
         self.followers.pop(peer_id, None)
+        self.appender_metrics.remove_follower_gauges(peer_id)
         a = self.appenders.pop(peer_id, None)
         if a is not None:
             await a.stop()
@@ -275,6 +285,7 @@ class LeaderContext:
         for a in list(self.appenders.values()):
             await a.stop()
         self.appenders.clear()
+        self.appender_metrics.unregister()
         if exception is not None:
             self.pending.drain_not_leader(exception)
         if not self.leader_ready.done():

@@ -27,6 +27,7 @@ import os
 import pathlib
 import re
 import struct
+import time
 import zlib
 from typing import Optional
 
@@ -87,6 +88,11 @@ class LogWorker:
         self._task: Optional[asyncio.Task] = None
         self._refs = 0
         self.metrics = {"flushes": 0, "writes": 0, "batched": 0}
+        # registry view (reference log_worker catalog: flushTime/flushCount/
+        # syncTime over the shared per-device worker)
+        from ratis_tpu.metrics import LogWorkerMetrics
+        self.registry_metrics = LogWorkerMetrics(f"device-{name}")
+        self.registry_metrics.add_queue_gauges(lambda: len(self._queue))
 
     @classmethod
     def shared(cls, device_key: str) -> "LogWorker":
@@ -116,6 +122,7 @@ class LogWorker:
             except asyncio.CancelledError:
                 pass
             self._instances.pop(self.name, None)
+            self.registry_metrics.unregister()
 
     def submit(self, fileobj, data: bytes) -> asyncio.Future:
         fut = asyncio.get_event_loop().create_future()
@@ -148,13 +155,18 @@ class LogWorker:
                     fileobj.write(data)
                     if fileobj not in files:
                         files.append(fileobj)
+                t_sync = time.perf_counter()
                 for f in files:
                     f.flush()
                     os.fsync(f.fileno())
+                self.registry_metrics.sync_timer.update(
+                    time.perf_counter() - t_sync)
 
             try:
-                await asyncio.to_thread(_do_io)
+                with self.registry_metrics.flush_timer.time():
+                    await asyncio.to_thread(_do_io)
                 self.metrics["flushes"] += 1
+                self.registry_metrics.flush_count.inc()
                 for _, _, fut in batch:
                     if not fut.done():
                         fut.set_result(None)
@@ -199,6 +211,8 @@ class SegmentedRaftLog(RaftLog):
         self._open_file = None
         self._flush_index = INVALID_LOG_INDEX
         self._below_start: Optional[TermIndex] = None
+        from ratis_tpu.metrics import SegmentedRaftLogMetrics
+        self.metrics = SegmentedRaftLogMetrics(name)
 
     # ------------------------------------------------------------- recovery
 
@@ -257,6 +271,7 @@ class SegmentedRaftLog(RaftLog):
             self._open_file.close()
             self._open_file = None
         await self.worker.release()
+        self.metrics.unregister()
         await super().close()
 
     def _close_segment_file(self, seg: _Segment) -> None:
@@ -321,6 +336,10 @@ class SegmentedRaftLog(RaftLog):
         self._close_segment_file(seg)
 
     async def append_entry(self, entry: LogEntry) -> int:
+        with self.metrics.append_timer.time():
+            return await self._append_entry_impl(entry)
+
+    async def _append_entry_impl(self, entry: LogEntry) -> int:
         expected = self.next_index
         if entry.index != expected:
             raise ValueError(f"{self.name}: appending index {entry.index}, "
@@ -344,6 +363,7 @@ class SegmentedRaftLog(RaftLog):
     # ------------------------------------------------------------ truncate
 
     async def truncate(self, index: int) -> None:
+        self.metrics.truncate_count.inc()
         await self.worker.drain()
         while self._segments and self._segments[-1].start >= index:
             seg = self._segments.pop()
@@ -379,6 +399,7 @@ class SegmentedRaftLog(RaftLog):
         """Drop whole segments with end <= index (snapshot-covered); the
         reference purges at segment granularity too (purgeImpl)."""
         ti = self.get_term_index(index)
+        self.metrics.purge_count.inc()
         # Roll the open segment first when the snapshot fully covers it, so
         # purge can reclaim it too (otherwise a single-open-segment log would
         # never shrink after snapshotting).

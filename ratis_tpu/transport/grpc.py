@@ -70,11 +70,6 @@ class _ChannelPool:
             self._channels[address] = ch
         return ch
 
-    def drop(self, address: str) -> None:
-        ch = self._channels.pop(address, None)
-        if ch is not None:
-            asyncio.ensure_future(ch.close())
-
     async def close(self) -> None:
         for ch in self._channels.values():
             await ch.close()
@@ -170,9 +165,9 @@ class GrpcServerTransport(ServerTransport):
                                      timeout=self.request_timeout_s)
         except grpc.aio.AioRpcError as e:
             if e.code() in _TRANSIENT_CODES:
-                if e.code() == grpc.StatusCode.UNAVAILABLE:
-                    # peer may have restarted on a new address; rebuild
-                    self._pool.drop(address)
+                # Keep the shared channel: grpc.aio reconnects by itself,
+                # while close() would cancel concurrent in-flight RPCs to
+                # this peer (e.g. a snapshot chunk riding the same channel).
                 raise TimeoutIOException(
                     f"{self.peer_id}->{to} {e.code().name}: {e.details()}") \
                     from None
@@ -206,8 +201,6 @@ class GrpcClientTransport(ClientTransport):
             reply_bytes = await call(request.to_bytes(), timeout=timeout)
         except grpc.aio.AioRpcError as e:
             if e.code() in _TRANSIENT_CODES:
-                if e.code() == grpc.StatusCode.UNAVAILABLE:
-                    self._pool.drop(peer_address)
                 raise TimeoutIOException(
                     f"client->{peer_address} {e.code().name}: "
                     f"{e.details()}") from None

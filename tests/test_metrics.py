@@ -1,0 +1,92 @@
+"""Metrics registry + server wiring tests (reference ratis-metrics-api
+tests and the metric catalog in ratis-docs/src/site/markdown/metrics.md)."""
+
+import asyncio
+
+from ratis_tpu.metrics import (MetricRegistries, MetricRegistryInfo,
+                               RatisMetricRegistry, Timekeeper)
+from tests.minicluster import run_with_new_cluster
+
+
+def test_registry_counter_gauge_timer():
+    info = MetricRegistryInfo("p0", "ratis", "test", "m")
+    reg = RatisMetricRegistry(info)
+    c = reg.counter("requests")
+    c.inc()
+    c.inc(4)
+    assert c.count == 5
+    reg.gauge("depth", lambda: 42)
+    t = reg.timer("latency")
+    with t.time():
+        pass
+    snap = reg.snapshot()
+    assert snap["requests"] == 5
+    assert snap["depth"] == 42
+    assert snap["latency"]["count"] == 1
+    assert info.full_name == "ratis.test.p0.m"
+
+
+def test_timer_percentiles():
+    t = Timekeeper()
+    for i in range(100):
+        t.update(i / 1000.0)
+    assert t.count == 100
+    assert 0.0 <= t.percentile_s(0.5) <= 0.099
+    assert t.percentile_s(0.99) >= t.percentile_s(0.5)
+    assert t.snapshot()["max_s"] == 0.099
+
+
+def test_global_registries_create_remove():
+    regs = MetricRegistries.global_registries()
+    info = MetricRegistryInfo("x", "ratis", "test", "create_remove")
+    reg = regs.create(info)
+    assert regs.create(info) is reg  # idempotent
+    assert regs.get(info) is reg
+    assert regs.remove(info)
+    assert regs.get(info) is None
+    assert not regs.remove(info)
+
+
+def test_server_metrics_wiring():
+    """A live cluster registers the metrics.md catalog and counts traffic."""
+
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        for _ in range(3):
+            reply = await cluster.send_write(b"INCREMENT")
+            assert reply.success
+        reply = await cluster.send_read(b"GET")
+        assert reply.success
+
+        m = leader.metrics
+        assert m.num_requests.count >= 4
+        assert m.write_timer.count >= 3
+        assert m.read_timer.count >= 1
+        # one election happened and recorded itself
+        assert leader.election_metrics.election_count.count >= 1
+        assert leader.sm_metrics.applied_count.count >= 3
+        snap = m.snapshot()
+        assert snap["commitInfos"]["appliedIndex"] >= 3
+        # followers timed the replicated appends
+        followers = [d for d in cluster.divisions() if d.is_follower()]
+        assert any(f.metrics.follower_append_timer.count > 0
+                   for f in followers)
+        # registry is discoverable globally by full name
+        names = [i.full_name
+                 for i in MetricRegistries.global_registries()
+                 .get_registry_infos()]
+        assert any("raft_server" in n for n in names)
+
+    run_with_new_cluster(3, _test)
+
+
+def test_retry_cache_metrics():
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        client_id = None
+        reply = await cluster.send_write(b"INCREMENT")
+        assert reply.success
+        misses = leader.metrics.retry_cache_miss.count
+        assert misses >= 1
+
+    run_with_new_cluster(3, _test)
