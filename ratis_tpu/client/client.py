@@ -45,6 +45,7 @@ from ratis_tpu.protocol.peer import RaftPeer
 from ratis_tpu.protocol.requests import (RaftClientReply, RaftClientRequest,
                                          ReplicationLevel, RequestType,
                                          TypeCase, admin_request_type,
+                                         message_stream_request_type,
                                          read_request_type,
                                          stale_read_request_type,
                                          watch_request_type,
@@ -85,6 +86,7 @@ class RaftClient:
         # (reference RepliedCallIds, RaftClientImpl.java:128).
         self._replied_call_ids: set[int] = set()
         self._ordered = OrderedApi(self)
+        self._message_stream = MessageStreamApi(self)
         self._admin = AdminApi(self)
         self._group_mgmt = GroupManagementApi(self)
         self._snapshot_mgmt = SnapshotManagementApi(self)
@@ -101,6 +103,9 @@ class RaftClient:
 
     def async_api(self) -> "OrderedApi":
         return self._ordered  # one asyncio-native API serves both roles
+
+    def message_stream(self) -> "MessageStreamApi":
+        return self._message_stream
 
     def admin(self) -> "AdminApi":
         return self._admin
@@ -352,6 +357,43 @@ class OrderedApi:
         return await self.client.send_request_with_retry(
             Message.EMPTY, watch_request_type(index, replication),
             timeout_ms=30_000.0)
+
+
+class MessageStreamApi:
+    """Split one large Message into ordered sub-requests sharing a stream id
+    (reference MessageStreamImpl + RaftOutputStream,
+    ratis-client/.../impl/MessageStreamImpl.java).  All chunks but the last
+    must land before end_of_request replays the assembled write, so chunks
+    are sent strictly in order through the same failover-aware retry loop.
+    """
+
+    DEFAULT_SUBMESSAGE_SIZE = 1 << 20
+
+    def __init__(self, client: RaftClient,
+                 submessage_size: int = DEFAULT_SUBMESSAGE_SIZE):
+        self.client = client
+        self.submessage_size = submessage_size
+        self._stream_ids = itertools.count(1)
+
+    async def stream_async(self, message: "Message | bytes",
+                           submessage_size: Optional[int] = None
+                           ) -> RaftClientReply:
+        """Send ``message`` as one stream; returns the final write reply."""
+        data = message.content if isinstance(message, Message) else message
+        size = submessage_size or self.submessage_size
+        if size <= 0:
+            raise ValueError(f"submessage_size must be positive, got {size}")
+        stream_id = next(self._stream_ids)
+        chunks = [data[i:i + size] for i in range(0, len(data), size)] or [b""]
+        for message_id, chunk in enumerate(chunks[:-1]):
+            reply = await self.client.send_request_with_retry(
+                Message(chunk),
+                message_stream_request_type(stream_id, message_id, False))
+            if not reply.success:
+                return reply
+        return await self.client.send_request_with_retry(
+            Message(chunks[-1]),
+            message_stream_request_type(stream_id, len(chunks) - 1, True))
 
 
 class AdminApi:

@@ -32,7 +32,8 @@ from ratis_tpu.protocol.exceptions import (LeaderNotReadyException,
                                            LeaderSteppingDownException,
                                            NotLeaderException, RaftException,
                                            StaleReadException,
-                                           StateMachineException)
+                                           StateMachineException,
+                                           StreamException)
 from ratis_tpu.protocol.group import RaftGroup, RaftGroupMemberId
 from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
 from ratis_tpu.protocol.logentry import (LogEntry, LogEntryKind,
@@ -107,6 +108,9 @@ class Division:
             RaftServerConfigKeys.Read.leader_lease_enabled(p),
             RaftServerConfigKeys.Read.leader_lease_timeout_ratio(p),
             RaftServerConfigKeys.Rpc.timeout_min(p).to_ms())
+        from ratis_tpu.server.messagestream import MessageStreamRequests
+        self.message_stream_requests = MessageStreamRequests(
+            RaftServerConfigKeys.Write.byte_limit(p))
         self.snapshot_installer = SnapshotInstaller(self)
         self.snapshot_sender = SnapshotSender(
             self,
@@ -458,6 +462,7 @@ class Division:
                 await self.state_machine.notify_leader_changed(
                     self.member_id, leader_id)
         if old_role == RaftPeerRole.LEADER and self.leader_ctx is not None:
+            self.message_stream_requests.clear()
             ctx = self.leader_ctx
             self.leader_ctx = None
             nle = NotLeaderException(self.member_id, self.get_leader_peer(),
@@ -1140,9 +1145,31 @@ class Division:
         return RaftClientReply.success_reply(req, log_index=frontier)
 
     async def _message_stream_async(self, req: RaftClientRequest) -> RaftClientReply:
-        """MessageStream sub-request accumulation — stream milestone."""
-        return RaftClientReply.failure_reply(
-            req, RaftException("message stream not yet supported"))
+        """MessageStream sub-request accumulation
+        (RaftServerImpl.messageStreamAsync:1111 + MessageStreamRequests)."""
+        err = self._check_leader(req)
+        if err is not None:
+            return err
+        try:
+            if not req.type.end_of_request:
+                self.message_stream_requests.stream_async(req)
+                return RaftClientReply.success_reply(req)
+            write_req = \
+                self.message_stream_requests.stream_end_of_request_async(req)
+        except RaftException as e:
+            return RaftClientReply.failure_reply(req, e)
+        if write_req is self.message_stream_requests.RETIRED:
+            # re-sent end-of-request: the assembled write already ran; only
+            # the retry cache may answer (re-executing with just the final
+            # chunk would corrupt the payload)
+            entry = self.retry_cache.get(req.client_id.to_bytes(),
+                                         req.call_id)
+            if entry is not None and entry.done():
+                return await entry.future
+            return RaftClientReply.failure_reply(req, StreamException(
+                f"stream {req.type.stream_id}: already assembled but the "
+                "reply is no longer cached; restart the stream"))
+        return await self._write_async(write_req)
 
     async def _stale_read_async(self, req: RaftClientRequest) -> RaftClientReply:
         min_index = req.type.stale_read_min_index

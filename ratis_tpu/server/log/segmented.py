@@ -87,12 +87,20 @@ class LogWorker:
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._refs = 0
-        self.metrics = {"flushes": 0, "writes": 0, "batched": 0}
-        # registry view (reference log_worker catalog: flushTime/flushCount/
-        # syncTime over the shared per-device worker)
+        # single metric source (reference log_worker catalog: flushTime/
+        # flushCount/syncTime over the shared per-device worker)
         from ratis_tpu.metrics import LogWorkerMetrics
         self.registry_metrics = LogWorkerMetrics(f"device-{name}")
         self.registry_metrics.add_queue_gauges(lambda: len(self._queue))
+        self._writes = self.registry_metrics.registry.counter("writeCount")
+        self._batches = self.registry_metrics.registry.counter("batchCount")
+
+    @property
+    def metrics(self) -> dict:
+        """Snapshot view kept for tests/tools."""
+        return {"flushes": self.registry_metrics.flush_count.count,
+                "writes": self._writes.count,
+                "batched": self._batches.count}
 
     @classmethod
     def shared(cls, device_key: str) -> "LogWorker":
@@ -146,8 +154,8 @@ class LogWorker:
             batch, self._queue = self._queue, []
             if not batch:
                 continue
-            self.metrics["writes"] += len(batch)
-            self.metrics["batched"] += 1
+            self._writes.inc(len(batch))
+            self._batches.inc()
 
             def _do_io():
                 files = []
@@ -165,7 +173,6 @@ class LogWorker:
             try:
                 with self.registry_metrics.flush_timer.time():
                     await asyncio.to_thread(_do_io)
-                self.metrics["flushes"] += 1
                 self.registry_metrics.flush_count.inc()
                 for _, _, fut in batch:
                     if not fut.done():

@@ -1,0 +1,179 @@
+"""MessageStream: accumulate a chunked Message into one log entry.
+
+Capability parity with the reference MessageStreamApi server side
+(ratis-server/src/main/java/org/apache/ratis/server/impl/MessageStreamRequests.java,
+RaftServerImpl.messageStreamAsync:1111): a client splits one large Message
+into sub-requests sharing a ``stream_id`` with increasing ``message_id``;
+the server appends each chunk in order and, on ``end_of_request``, replays
+the assembled bytes through the normal write path as a single transaction.
+Long-payload scaling analog of sequence parallelism (SURVEY.md §2.9).
+
+Retry semantics (the client's failover loop re-sends a chunk whose reply
+was lost): a duplicate of the *last* appended chunk is acked as a no-op,
+and a retried end-of-request for an already-assembled stream is answered
+from the retry cache keyed by the write's (clientId, callId) — see
+``RETIRED`` handling in Division._message_stream_async.  Streams idle
+longer than ``expiry_s`` are lazily reclaimed so an abandoned client
+cannot pin the byte budget forever.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Deque, Dict, Optional, Tuple
+
+from ratis_tpu.protocol.exceptions import StreamException
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.requests import (RaftClientRequest,
+                                         write_request_type)
+
+Key = Tuple[bytes, int]  # (clientId, streamId)
+
+
+class _PendingStream:
+    """One in-flight stream (reference PendingStream): ordered chunks."""
+
+    __slots__ = ("stream_id", "next_id", "chunks", "touched_s")
+
+    def __init__(self, stream_id: int) -> None:
+        self.stream_id = stream_id
+        self.next_id = 0
+        self.chunks: list[bytes] = []
+        self.touched_s = time.monotonic()
+
+    def is_duplicate(self, message_id: int, message: Message) -> bool:
+        """A re-sent copy of the chunk we appended last (reply was lost)."""
+        return (message_id == self.next_id - 1 and self.chunks
+                and self.chunks[-1] == message.content)
+
+    def append(self, message_id: int, message: Message) -> None:
+        if message_id != self.next_id:
+            raise StreamException(
+                f"stream {self.stream_id}: out-of-order chunk "
+                f"{message_id}, expected {self.next_id}")
+        self.chunks.append(message.content)
+        self.next_id += 1
+        self.touched_s = time.monotonic()
+
+    @property
+    def size(self) -> int:
+        return sum(len(c) for c in self.chunks)
+
+    def assemble(self) -> Message:
+        return Message(b"".join(self.chunks))
+
+
+class MessageStreamRequests:
+    """Per-division registry of pending streams keyed by (clientId, streamId).
+
+    ``stream_end_of_request_async`` returns either the assembled WRITE
+    request or :data:`RETIRED` when this (stream, call id) already
+    assembled — the caller must then answer from the retry cache.
+    """
+
+    RETIRED = object()
+    MAX_RETIRED = 4096
+
+    def __init__(self, byte_limit: int = 64 << 20,
+                 expiry_s: float = 300.0) -> None:
+        self._streams: Dict[Key, _PendingStream] = {}
+        self._retired: Deque[Tuple[Key, int]] = collections.deque(
+            maxlen=self.MAX_RETIRED)  # (key, end-of-request callId)
+        self._byte_limit = byte_limit
+        self._expiry_s = expiry_s
+        self._bytes = 0
+
+    # -------------------------------------------------------------- chunks
+
+    def _check_and_account(self, stream: Optional[_PendingStream],
+                           key: Key, size: int) -> None:
+        if self._bytes + size > self._byte_limit:
+            if stream is not None:
+                self._drop(key)
+            raise StreamException(
+                f"stream {key[1]}: byte limit {self._byte_limit} exceeded")
+        self._bytes += size
+
+    def stream_async(self, request: RaftClientRequest) -> None:
+        """Append a non-final chunk; duplicate last chunks are acked no-op;
+        raises StreamException on true disorder."""
+        self._expire_idle()
+        t = request.type
+        key = (request.client_id.to_bytes(), t.stream_id)
+        stream = self._streams.get(key)
+        if stream is None:
+            stream = _PendingStream(t.stream_id)
+            self._streams[key] = stream
+        if stream.is_duplicate(t.message_id, request.message):
+            stream.touched_s = time.monotonic()
+            return
+        self._check_and_account(stream, key, len(request.message.content))
+        try:
+            stream.append(t.message_id, request.message)
+        except StreamException:
+            self._bytes -= len(request.message.content)
+            self._drop(key)
+            raise
+
+    def stream_end_of_request_async(self, request: RaftClientRequest):
+        """Final chunk: returns the assembled WRITE request (same client id +
+        call id, so the retry cache dedupes normally), or :data:`RETIRED`
+        for a re-sent end-of-request whose stream already assembled."""
+        self._expire_idle()
+        t = request.type
+        key = (request.client_id.to_bytes(), t.stream_id)
+        stream = self._streams.get(key)
+        if stream is None:
+            if (key, request.call_id) in self._retired:
+                return self.RETIRED
+            if t.message_id != 0:
+                raise StreamException(
+                    f"stream {t.stream_id}: unknown stream for final chunk "
+                    f"{t.message_id} (lost to failover? restart the stream)")
+            stream = _PendingStream(t.stream_id)
+            self._streams[key] = stream
+        if not stream.is_duplicate(t.message_id, request.message):
+            self._check_and_account(stream, key,
+                                    len(request.message.content))
+            try:
+                stream.append(t.message_id, request.message)
+            except StreamException:
+                self._bytes -= len(request.message.content)
+                self._drop(key)
+                raise
+        message = stream.assemble()
+        self._drop(key)
+        self._retired.append((key, request.call_id))
+        return RaftClientRequest(
+            request.client_id, request.server_id, request.group_id,
+            request.call_id, message, type=write_request_type(),
+            timeout_ms=request.timeout_ms,
+            replied_call_ids=request.replied_call_ids)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _drop(self, key: Key) -> None:
+        stream = self._streams.pop(key, None)
+        if stream is not None:
+            self._bytes -= stream.size
+
+    def _expire_idle(self) -> None:
+        if self._expiry_s <= 0:
+            return
+        deadline = time.monotonic() - self._expiry_s
+        for key in [k for k, s in self._streams.items()
+                    if s.touched_s < deadline]:
+            self._drop(key)
+
+    def clear(self) -> None:
+        self._streams.clear()
+        self._retired.clear()
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._streams)
+
+    @property
+    def pending_bytes(self) -> int:
+        return self._bytes

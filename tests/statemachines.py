@@ -1,0 +1,85 @@
+"""Test state machines (reference SimpleStateMachine4Testing,
+ratis-server/src/test/.../statemachine/impl/): records every applied entry,
+supports blocking/unblocking apply and start_transaction, and snapshot
+round-trips — the teaching SM the per-transport suites drive."""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from typing import List, Optional
+
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.server.statemachine import (BaseStateMachine, SnapshotInfo,
+                                           TransactionContext)
+
+
+class RecordingStateMachine(BaseStateMachine):
+    """Records applied payloads in order; query returns the record count,
+    ``LAST`` returns the last payload."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.applied: List[bytes] = []
+        self._apply_gate = asyncio.Event()
+        self._apply_gate.set()
+        self._txn_gate = asyncio.Event()
+        self._txn_gate.set()
+
+    # ----------------------------------------------------- fault injection
+
+    def block_apply(self) -> None:
+        self._apply_gate.clear()
+
+    def unblock_apply(self) -> None:
+        self._apply_gate.set()
+
+    def block_start_transaction(self) -> None:
+        self._txn_gate.clear()
+
+    def unblock_start_transaction(self) -> None:
+        self._txn_gate.set()
+
+    # ------------------------------------------------------------ pipeline
+
+    async def start_transaction(self, request) -> TransactionContext:
+        await self._txn_gate.wait()
+        return TransactionContext(client_request=request,
+                                  log_data=request.message.content)
+
+    async def apply_transaction(self, trx: TransactionContext) -> Message:
+        await self._apply_gate.wait()
+        e = trx.log_entry
+        payload = (e.smlog.log_data if e is not None and e.smlog is not None
+                   else (trx.log_data or b""))
+        self.applied.append(payload)
+        if e is not None:
+            self.update_last_applied_term_index(e.term, e.index)
+        return Message.value_of(str(len(self.applied)))
+
+    async def query(self, request: Message) -> Message:
+        if request.content == b"LAST":
+            return Message(self.applied[-1] if self.applied else b"")
+        return Message.value_of(str(len(self.applied)))
+
+    async def query_stale(self, request: Message, min_index: int) -> Message:
+        return await self.query(request)
+
+    # ------------------------------------------------------------ snapshot
+
+    async def take_snapshot(self) -> int:
+        ti = self.get_last_applied_term_index()
+        if ti.index < 0 or self._storage.directory is None:
+            return -1
+        path = self._storage.snapshot_path(ti.term, ti.index)
+        path.write_bytes(pickle.dumps(self.applied))
+        return ti.index
+
+    async def restore_from_snapshot(self,
+                                    snapshot: Optional[SnapshotInfo]) -> None:
+        if snapshot is None or not snapshot.files:
+            return
+        import pathlib
+        self.applied = pickle.loads(
+            pathlib.Path(snapshot.files[0].path).read_bytes())
+        self.set_last_applied_term_index(snapshot.term_index)

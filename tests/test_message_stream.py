@@ -1,0 +1,146 @@
+"""MessageStream tests (reference MessageStreamApi: MessageStreamImpl +
+MessageStreamRequests; RaftServerImpl.messageStreamAsync:1111)."""
+
+import pytest
+
+from ratis_tpu.protocol.exceptions import StreamException
+from ratis_tpu.protocol.ids import ClientId, RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.requests import (RaftClientRequest,
+                                         message_stream_request_type)
+from ratis_tpu.server.messagestream import MessageStreamRequests
+from tests.minicluster import run_with_new_cluster
+from tests.statemachines import RecordingStateMachine
+
+
+def _req(client_id, stream_id, message_id, eor, payload=b"x"):
+    return RaftClientRequest(
+        client_id, RaftPeerId.value_of("s0"), RaftGroupId.random_id(),
+        call_id=message_id, message=Message(payload),
+        type=message_stream_request_type(stream_id, message_id, eor))
+
+
+def test_accumulator_assembles_in_order():
+    msr = MessageStreamRequests()
+    cid = ClientId.random_id()
+    msr.stream_async(_req(cid, 1, 0, False, b"aa"))
+    msr.stream_async(_req(cid, 1, 1, False, b"bb"))
+    write = msr.stream_end_of_request_async(_req(cid, 1, 2, True, b"cc"))
+    assert write.message.content == b"aabbcc"
+    assert write.is_write()
+    assert len(msr) == 0  # stream retired
+
+
+def test_accumulator_rejects_out_of_order():
+    msr = MessageStreamRequests()
+    cid = ClientId.random_id()
+    msr.stream_async(_req(cid, 7, 0, False))
+    with pytest.raises(StreamException):
+        msr.stream_async(_req(cid, 7, 2, False))
+    # stream dropped: restart from 0 works
+    msr.stream_async(_req(cid, 7, 0, False, b"z"))
+    write = msr.stream_end_of_request_async(_req(cid, 7, 1, True, b"!"))
+    assert write.message.content == b"z!"
+
+
+def test_accumulator_byte_limit():
+    msr = MessageStreamRequests(byte_limit=10)
+    cid = ClientId.random_id()
+    with pytest.raises(StreamException):
+        msr.stream_async(_req(cid, 1, 0, False, b"x" * 11))
+    assert len(msr) == 0
+
+
+def test_duplicate_chunk_is_acked_noop():
+    """A re-sent chunk (lost reply) must not abort the stream."""
+    msr = MessageStreamRequests()
+    cid = ClientId.random_id()
+    msr.stream_async(_req(cid, 1, 0, False, b"aa"))
+    msr.stream_async(_req(cid, 1, 0, False, b"aa"))  # client retry
+    msr.stream_async(_req(cid, 1, 1, False, b"bb"))
+    write = msr.stream_end_of_request_async(_req(cid, 1, 2, True, b"cc"))
+    assert write.message.content == b"aabbcc"
+
+
+def test_retried_end_of_request_returns_retired():
+    msr = MessageStreamRequests()
+    cid = ClientId.random_id()
+    msr.stream_async(_req(cid, 1, 0, False, b"aa"))
+    final = _req(cid, 1, 1, True, b"bb")
+    write = msr.stream_end_of_request_async(final)
+    assert write.message.content == b"aabb"
+    # retry of the same end-of-request: caller must consult the retry cache
+    assert msr.stream_end_of_request_async(final) is msr.RETIRED
+    # while a different (never-seen) stream's late final chunk still fails
+    with pytest.raises(StreamException):
+        msr.stream_end_of_request_async(_req(cid, 9, 3, True, b"zz"))
+
+
+def test_byte_accounting_stays_exact():
+    msr = MessageStreamRequests(byte_limit=100)
+    cid = ClientId.random_id()
+    for round_no in range(5):  # a leaky account would go negative and
+        sid = round_no + 1     # stop enforcing the limit
+        msr.stream_async(_req(cid, sid, 0, False, b"x" * 40))
+        msr.stream_end_of_request_async(_req(cid, sid, 1, True, b"y" * 40))
+        assert msr.pending_bytes == 0
+    # the final chunk counts against the limit too
+    msr.stream_async(_req(cid, 99, 0, False, b"x" * 70))
+    with pytest.raises(StreamException):
+        msr.stream_end_of_request_async(_req(cid, 99, 1, True, b"y" * 70))
+
+
+def test_idle_stream_expires(monkeypatch):
+    import time as time_mod
+    msr = MessageStreamRequests(byte_limit=100, expiry_s=10.0)
+    cid = ClientId.random_id()
+    msr.stream_async(_req(cid, 1, 0, False, b"x" * 90))  # abandoned
+    now = time_mod.monotonic()
+    monkeypatch.setattr("ratis_tpu.server.messagestream.time.monotonic",
+                        lambda: now + 11.0)
+    cid2 = ClientId.random_id()
+    msr.stream_async(_req(cid2, 1, 0, False, b"y" * 90))  # fits again
+    assert msr.pending_bytes == 90
+
+
+def test_independent_streams_per_client():
+    msr = MessageStreamRequests()
+    c1, c2 = ClientId.random_id(), ClientId.random_id()
+    msr.stream_async(_req(c1, 1, 0, False, b"one"))
+    msr.stream_async(_req(c2, 1, 0, False, b"two"))
+    w1 = msr.stream_end_of_request_async(_req(c1, 1, 1, True, b"+"))
+    w2 = msr.stream_end_of_request_async(_req(c2, 1, 1, True, b"-"))
+    assert w1.message.content == b"one+"
+    assert w2.message.content == b"two-"
+
+
+def test_end_to_end_large_message():
+    """A 200KB message streamed in 16KB chunks lands as ONE applied entry."""
+
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        big = bytes(range(256)) * 800  # 204800 bytes
+        async with cluster.new_client() as client:
+            reply = await client.message_stream().stream_async(
+                big, submessage_size=16 << 10)
+            assert reply.success
+            read = await client.io().send_read_only(b"LAST")
+        assert read.message.content == big
+        # every replica applied exactly one entry with the full payload
+        for div in cluster.divisions():
+            if big in div.state_machine.applied:
+                assert div.state_machine.applied.count(big) == 1
+
+    run_with_new_cluster(3, _test, sm_factory=RecordingStateMachine)
+
+
+def test_end_to_end_single_chunk():
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            reply = await client.message_stream().stream_async(b"small")
+            assert reply.success
+            read = await client.io().send_read_only(b"LAST")
+            assert read.message.content == b"small"
+
+    run_with_new_cluster(3, _test, sm_factory=RecordingStateMachine)

@@ -1,0 +1,85 @@
+"""Model state-machine tests (reference ratis-examples arithmetic/counter
+suites: TestArithmetic, arithmetic/TestArithmeticLogDump)."""
+
+import pytest
+
+from ratis_tpu.models.arithmetic import ArithmeticStateMachine, evaluate
+from tests.minicluster import run_with_new_cluster
+
+
+def test_evaluate_arithmetic():
+    assert evaluate("1 + 2 * 3", {}) == 7
+    assert evaluate("a + b", {"a": 1.5, "b": 2.5}) == 4.0
+    assert evaluate("sqrt(a**2 + b**2)", {"a": 3, "b": 4}) == 5.0
+    assert evaluate("-a", {"a": 2}) == -2
+
+
+def test_evaluate_rejects_unsafe():
+    for bad in ("__import__('os')", "a.b", "lambda: 1", "[1,2]", "'str'",
+                "open('/etc/passwd')"):
+        with pytest.raises((ValueError, SyntaxError)):
+            evaluate(bad, {"a": 1})
+
+
+def test_evaluate_undefined_variable():
+    with pytest.raises(ValueError):
+        evaluate("x + 1", {})
+
+
+def test_arithmetic_cluster_end_to_end():
+    """Pythagorean demo from the reference README: a=3, b=4, c=sqrt(a²+b²)."""
+
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for assignment in (b"a = 3", b"b = 4",
+                               b"c = sqrt(a**2 + b**2)"):
+                reply = await client.io().send(assignment)
+                assert reply.success
+            read = await client.io().send_read_only(b"c")
+            assert float(read.message.content) == 5.0
+            reply = await client.io().send(b"d = 1")  # bump commit frontier
+            assert reply.success
+            await cluster.wait_applied(reply.log_index)
+        # replicated: every peer's map agrees
+        for div in cluster.divisions():
+            assert div.state_machine.variables.get("c") == 5.0
+
+    run_with_new_cluster(3, _test, sm_factory=ArithmeticStateMachine)
+
+
+def test_arithmetic_rejects_bad_assignment():
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            reply = await client.io().send(b"x = nope_undefined + 1")
+            assert not reply.success
+            # cluster still healthy afterwards
+            ok = await client.io().send(b"y = 2")
+            assert ok.success
+
+    run_with_new_cluster(3, _test, sm_factory=ArithmeticStateMachine)
+
+
+def test_arithmetic_snapshot_restart(tmp_path):
+    """Variables survive a full-cluster restart via snapshot + log replay."""
+
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for i in range(5):
+                reply = await client.io().send(f"v{i} = {i} * 10".encode())
+                assert reply.success
+            await client.snapshot_management().create()
+        peer_ids = [d.member_id.peer_id for d in cluster.divisions()]
+        for pid in list(peer_ids):
+            await cluster.kill_server(pid)
+        for pid in peer_ids:
+            await cluster.restart_server(pid)
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            read = await client.io().send_read_only(b"v4")
+            assert float(read.message.content) == 40.0
+
+    run_with_new_cluster(3, _test, sm_factory=ArithmeticStateMachine,
+                         storage_root=str(tmp_path))
