@@ -87,6 +87,7 @@ class RaftClient:
         self._replied_call_ids: set[int] = set()
         self._ordered = OrderedApi(self)
         self._message_stream = MessageStreamApi(self)
+        self._data_stream = DataStreamApi(self)
         self._admin = AdminApi(self)
         self._group_mgmt = GroupManagementApi(self)
         self._snapshot_mgmt = SnapshotManagementApi(self)
@@ -106,6 +107,9 @@ class RaftClient:
 
     def message_stream(self) -> "MessageStreamApi":
         return self._message_stream
+
+    def data_stream(self) -> "DataStreamApi":
+        return self._data_stream
 
     def admin(self) -> "AdminApi":
         return self._admin
@@ -394,6 +398,115 @@ class MessageStreamApi:
         return await self.client.send_request_with_retry(
             Message(chunks[-1]),
             message_stream_request_type(stream_id, len(chunks) - 1, True))
+
+
+class DataStreamOutput:
+    """One open client stream (reference DataStreamOutputImpl +
+    OrderedStreamAsync): header first, then pipelined data packets with a
+    bounded outstanding window; ``close_async`` returns the final
+    RaftClientReply of the raft write the primary submitted."""
+
+    def __init__(self, client: "RaftClient", request: RaftClientRequest,
+                 primary_address: str, routing, window: int = 16):
+        from ratis_tpu.transport.datastream import DataStreamConnection
+        self.client = client
+        self.request = request
+        self.routing = routing
+        self._conn = DataStreamConnection(primary_address)
+        self._stream_id = request.type.stream_id
+        self._offset = 0
+        self._sem = asyncio.Semaphore(window)
+        self._acks: list[asyncio.Future] = []
+        self._closed = False
+
+    async def _open(self) -> None:
+        from ratis_tpu.transport.datastream import (FLAG_PRIMARY, KIND_HEADER,
+                                                    Packet, encode_header)
+        await self._conn.connect()
+        header = Packet(KIND_HEADER, self._stream_id, 0, FLAG_PRIMARY,
+                        encode_header(self.request, self.routing))
+        ack = await (await self._conn.send(header))
+        if not ack.success:
+            await self._conn.close()
+            raise RaftException("datastream header rejected by primary")
+
+    async def write_async(self, data: bytes, sync: bool = False) -> None:
+        from ratis_tpu.transport.datastream import (FLAG_SYNC, KIND_DATA,
+                                                    Packet)
+        if self._closed:
+            raise RaftException("stream already closed")
+        if not data:
+            return  # zero-length write: nothing to send, and the ack would
+            # collide with the next packet's (stream, offset) key
+        await self._sem.acquire()
+        packet = Packet(KIND_DATA, self._stream_id, self._offset,
+                        FLAG_SYNC if sync else 0, data)
+        self._offset += len(data)
+        fut = await self._conn.send(packet)
+        fut.add_done_callback(lambda _f: self._sem.release())
+        self._acks.append(fut)
+
+    async def close_async(self) -> RaftClientReply:
+        from ratis_tpu.transport.datastream import (FLAG_CLOSE, KIND_DATA,
+                                                    Packet)
+        if self._closed:
+            raise RaftException("stream already closed")
+        self._closed = True
+        try:
+            acks = await asyncio.gather(*self._acks)
+            for ack in acks:
+                if not ack.success:
+                    raise RaftException(
+                        f"datastream packet at offset {ack.offset} failed")
+            close_pkt = Packet(KIND_DATA, self._stream_id, self._offset,
+                               FLAG_CLOSE, b"")
+            final = await (await self._conn.send(close_pkt))
+            if not final.success or not final.data:
+                raise RaftException("datastream close rejected")
+            return RaftClientReply.from_bytes(final.data)
+        finally:
+            await self._conn.close()
+
+
+class DataStreamApi:
+    """Bulk bytes around the raft log (reference DataStreamApi /
+    DataStreamClientImpl, ratis-client/.../impl/DataStreamClientImpl.java):
+    stream to a primary peer which fans out per the RoutingTable, then the
+    close submits one raft entry linking the data."""
+
+    def __init__(self, client: "RaftClient"):
+        self.client = client
+
+    async def stream(self, header_message: "Message | bytes",
+                     routing_table=None,
+                     primary: "RaftPeer | None" = None,
+                     window: int = 16) -> DataStreamOutput:
+        import random
+
+        from ratis_tpu.protocol.requests import data_stream_request_type
+        from ratis_tpu.protocol.routing import RoutingTable
+        msg = (header_message if isinstance(header_message, Message)
+               else Message(header_message))
+        c = self.client
+        if primary is None:
+            candidates = [p for p in c._peers.values()
+                          if p.datastream_address]
+            if not candidates:
+                raise RaftException("no peer has a datastream address")
+            leader = c._peers.get(c._leader_id) if c._leader_id else None
+            primary = (leader if leader is not None
+                       and leader.datastream_address else candidates[0])
+        if routing_table is None:
+            others = [p.id for p in c._peers.values()
+                      if p.id != primary.id and p.datastream_address]
+            routing_table = RoutingTable.star(primary.id, others)
+        stream_id = random.getrandbits(63)
+        req = c._new_request(msg, data_stream_request_type(stream_id),
+                             server_id=primary.id, timeout_ms=30_000.0)
+        out = DataStreamOutput(c, req, primary.datastream_address,
+                               routing_table, window=window)
+        await out._open()
+        return out
 
 
 class AdminApi:

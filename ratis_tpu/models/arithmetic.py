@@ -70,6 +70,23 @@ class ArithmeticStateMachine(BaseStateMachine):
         super().__init__()
         self.variables: Dict[str, float] = {}
 
+    async def start_transaction(self, request) -> TransactionContext:
+        """Reject malformed assignments before they consume a log entry
+        (counter/filestore pattern).  Only syntax is checked — variable
+        existence depends on entries still in flight, so name resolution
+        stays at apply time."""
+        trx = TransactionContext(client_request=request,
+                                 log_data=request.message.content)
+        try:
+            var, _, expression = request.message.content.decode().partition("=")
+            if not var.strip().isidentifier():
+                raise ValueError(
+                    f"invalid assignment target {var.strip()!r}")
+            ast.parse(expression.strip(), mode="eval")
+        except Exception as e:
+            trx.exception = e
+        return trx
+
     async def apply_transaction(self, trx: TransactionContext) -> Message:
         e = trx.log_entry
         assignment = (e.smlog.log_data if e is not None and e.smlog is not None

@@ -95,6 +95,12 @@ def message_stream_request_type(stream_id: int, message_id: int,
                     message_id=message_id, end_of_request=end_of_request)
 
 
+def data_stream_request_type(stream_id: int) -> TypeCase:
+    """Marks the header/submit request of a DataStream
+    (Raft.proto DataStreamRequestTypeProto:305)."""
+    return TypeCase(RequestType.DATA_STREAM, stream_id=stream_id)
+
+
 def admin_request_type(t: RequestType) -> TypeCase:
     return TypeCase(t)
 

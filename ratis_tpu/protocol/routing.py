@@ -1,0 +1,71 @@
+"""RoutingTable: DataStream fan-out topology.
+
+Capability parity with the reference RoutingTable
+(ratis-common/src/main/java/org/apache/ratis/protocol/RoutingTable.java,
+wire form RoutingTableProto, Raft.proto:320): for one stream, which peer
+the client talks to (the *primary*) and, per peer, the successors each
+peer forwards packets to — a chain, star, or tree over the group.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+from ratis_tpu.protocol.ids import RaftPeerId
+
+
+@dataclasses.dataclass(frozen=True)
+class RoutingTable:
+    """peer -> successors; empty means "primary forwards to everyone else"."""
+
+    routes: Tuple[Tuple[RaftPeerId, Tuple[RaftPeerId, ...]], ...] = ()
+
+    def get_successors(self, peer_id: RaftPeerId) -> Tuple[RaftPeerId, ...]:
+        for pid, successors in self.routes:
+            if pid == peer_id:
+                return successors
+        return ()
+
+    def is_empty(self) -> bool:
+        return not self.routes
+
+    @staticmethod
+    def chain(peers: Sequence[RaftPeerId]) -> "RoutingTable":
+        """primary -> p1 -> p2 -> ... (the reference chain topology)."""
+        routes = tuple((peers[i], (peers[i + 1],))
+                       for i in range(len(peers) - 1))
+        return RoutingTable(routes)
+
+    @staticmethod
+    def star(primary: RaftPeerId,
+             others: Iterable[RaftPeerId]) -> "RoutingTable":
+        """primary fans out to every other peer directly."""
+        return RoutingTable(((primary, tuple(others)),))
+
+    class Builder:
+        def __init__(self) -> None:
+            self._routes: Dict[RaftPeerId, list] = {}
+
+        def add_successor(self, peer: RaftPeerId,
+                          successor: RaftPeerId) -> "RoutingTable.Builder":
+            self._routes.setdefault(RaftPeerId.value_of(peer), []).append(
+                RaftPeerId.value_of(successor))
+            return self
+
+        def build(self) -> "RoutingTable":
+            return RoutingTable(tuple(
+                (pid, tuple(succ)) for pid, succ in self._routes.items()))
+
+    def to_dict(self) -> list:
+        return [[pid.id, [s.id for s in successors]]
+                for pid, successors in self.routes]
+
+    @staticmethod
+    def from_dict(data: Optional[list]) -> "RoutingTable":
+        if not data:
+            return RoutingTable()
+        return RoutingTable(tuple(
+            (RaftPeerId.value_of(pid),
+             tuple(RaftPeerId.value_of(s) for s in successors))
+            for pid, successors in data))

@@ -1,0 +1,236 @@
+"""DataStream server side: receive bulk bytes, fan out, link at apply.
+
+Capability parity with the reference DataStream server
+(ratis-netty/src/main/java/org/apache/ratis/netty/server/DataStreamManagement.java:85
++ NettyServerStreamRpc): the *primary* peer (the one the client connected
+to) opens a local DataChannel via ``StateMachine.data_stream``, forwards
+every packet to its successors per the stream's RoutingTable
+(getSuccessors:196), and on CLOSE — once the local channel is forced and
+every successor acked — submits the header RaftClientRequest through the
+ordinary consensus path; at apply each receiving peer ``data_link``s its
+streamed bytes to the committed entry (FileStoreStateMachine.java:196-216).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Optional, Tuple
+
+from ratis_tpu.protocol.exceptions import DataStreamException
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.requests import RaftClientRequest, RequestType
+from ratis_tpu.protocol.routing import RoutingTable
+from ratis_tpu.transport.datastream import (FLAG_CLOSE, FLAG_PRIMARY,
+                                            FLAG_SUCCESS, FLAG_SYNC,
+                                            KIND_DATA, KIND_HEADER,
+                                            KIND_REPLY, DataStreamConnection,
+                                            DataStreamServer, Packet,
+                                            PeerConnection, decode_header,
+                                            encode_header)
+
+LOG = logging.getLogger(__name__)
+
+LinkKey = Tuple[bytes, int]  # (clientId, callId) of the header request
+
+
+class StreamInfo:
+    """One receiving stream on one peer (reference StreamInfo:88-193)."""
+
+    def __init__(self, request: RaftClientRequest, is_primary: bool,
+                 local, remotes: "list[_RemoteStream]") -> None:
+        self.request = request
+        self.is_primary = is_primary
+        self.local = local            # StateMachine DataStream | None
+        self.remotes = remotes
+        self.next_offset = 0
+        self.bytes_written = 0
+        self.closed = False
+        self.touched_s = time.monotonic()
+
+
+class _RemoteStream:
+    """Forwarding leg to one successor (reference RemoteStream)."""
+
+    def __init__(self, peer_id: RaftPeerId, address: str) -> None:
+        self.peer_id = peer_id
+        self.address = address
+        self.conn = DataStreamConnection(address)
+
+    async def connect(self) -> None:
+        await self.conn.connect()
+
+    async def forward(self, packet: Packet) -> Packet:
+        """Forward and await the successor's ack."""
+        fut = await self.conn.send(packet)
+        reply = await fut
+        if not reply.success:
+            raise DataStreamException(
+                f"successor {self.peer_id} rejected stream "
+                f"{packet.stream_id} offset {packet.offset}")
+        return reply
+
+    async def close(self) -> None:
+        await self.conn.close()
+
+
+class DataStreamManagement:
+    """Per-server packet handler + the apply-time link registry."""
+
+    def __init__(self, server, address: str,
+                 expiry_s: float = 300.0) -> None:
+        self.server = server  # RaftServer
+        self.transport = DataStreamServer(address, self._on_packet)
+        # streamId -> StreamInfo while streaming (ids are client-random
+        # 64-bit, collision-free in practice)
+        self._streams: Dict[int, StreamInfo] = {}
+        # (clientId, callId) -> (StreamInfo, retired-at) awaiting apply-time
+        # link; swept together with idle streams so an aborted submit can't
+        # pin temp files/FDs on followers forever
+        self._links: Dict[LinkKey, Tuple[StreamInfo, float]] = {}
+        self._expiry_s = expiry_s
+
+    async def start(self) -> None:
+        await self.transport.start()
+
+    async def close(self) -> None:
+        await self.transport.close()
+        for info in list(self._streams.values()):
+            await self._cleanup(info)
+        for info, _ in list(self._links.values()):
+            await self._cleanup(info)
+        self._streams.clear()
+        self._links.clear()
+
+    # ------------------------------------------------------------- packets
+
+    async def _expire_idle(self) -> None:
+        """Reclaim streams whose client vanished mid-stream and links whose
+        raft entry never applied (lazy sweep, cf. MessageStreamRequests)."""
+        if self._expiry_s <= 0:
+            return
+        deadline = time.monotonic() - self._expiry_s
+        for sid in [s for s, i in self._streams.items()
+                    if i.touched_s < deadline]:
+            info = self._streams.pop(sid)
+            LOG.warning("expiring abandoned datastream %s", sid)
+            await self._cleanup(info)
+        for key in [k for k, (_, t) in self._links.items() if t < deadline]:
+            info, _ = self._links.pop(key)
+            await self._cleanup(info)
+
+    async def _on_packet(self, packet: Packet, conn: PeerConnection) -> None:
+        await self._expire_idle()
+        try:
+            if packet.kind == KIND_HEADER:
+                await self._on_header(packet)
+            elif packet.kind == KIND_DATA:
+                await self._on_data(packet)
+            else:
+                raise DataStreamException(f"unexpected kind {packet.kind}")
+        except Exception as e:
+            LOG.warning("datastream packet failed: %s", e)
+            await conn.send(Packet(KIND_REPLY, packet.stream_id,
+                                   packet.offset,
+                                   packet.flags & ~FLAG_SUCCESS, b""))
+            return
+        reply_data = b""
+        if packet.is_close:
+            reply_data = await self._finish(packet)
+        await conn.send(Packet(KIND_REPLY, packet.stream_id, packet.offset,
+                               packet.flags | FLAG_SUCCESS, reply_data))
+
+    async def _on_header(self, packet: Packet) -> None:
+        request, routing = decode_header(packet.data)
+        if packet.stream_id in self._streams:
+            return  # idempotent header retry
+        is_primary = bool(packet.flags & FLAG_PRIMARY)
+
+        division = self.server.get_division(request.group_id)
+        local = await division.state_machine.data_stream(request)
+
+        remotes: list[_RemoteStream] = []
+        successors = routing.get_successors(self.server.peer_id)
+        for pid in successors:
+            peer = division.state.configuration.get_peer(pid)
+            if peer is None or not peer.datastream_address:
+                raise DataStreamException(
+                    f"successor {pid} has no datastream address")
+            remotes.append(_RemoteStream(pid, peer.datastream_address))
+
+        info = StreamInfo(request, is_primary, local, remotes)
+        self._streams[packet.stream_id] = info
+        try:
+            forwarded = Packet(KIND_HEADER, packet.stream_id, packet.offset,
+                               packet.flags & ~FLAG_PRIMARY, packet.data)
+            await asyncio.gather(*(r.connect() for r in remotes))
+            await asyncio.gather(*(r.forward(forwarded) for r in remotes))
+        except Exception:
+            self._streams.pop(packet.stream_id, None)
+            await self._cleanup(info)
+            raise
+
+    def _info_for(self, packet: Packet) -> StreamInfo:
+        info = self._streams.get(packet.stream_id)
+        if info is None:
+            raise DataStreamException(f"unknown stream {packet.stream_id}")
+        return info
+
+    async def _on_data(self, packet: Packet) -> None:
+        info = self._info_for(packet)
+        info.touched_s = time.monotonic()
+        if packet.offset != info.next_offset:
+            raise DataStreamException(
+                f"stream {packet.stream_id}: out-of-order offset "
+                f"{packet.offset}, expected {info.next_offset}")
+        local_write = info.local.channel.write(packet.data)
+        forwards = [r.forward(packet) for r in info.remotes]
+        results = await asyncio.gather(local_write, *forwards)
+        written = results[0]
+        if written != len(packet.data):
+            raise DataStreamException(
+                f"short write {written}/{len(packet.data)}")
+        info.next_offset += len(packet.data)
+        info.bytes_written += len(packet.data)
+        if packet.is_sync or packet.is_close:
+            await info.local.channel.force()
+
+    async def _finish(self, packet: Packet) -> bytes:
+        """CLOSE handling after the data landed everywhere: primary submits
+        the raft write; reply bytes ride back in the CLOSE ack."""
+        info = self._info_for(packet)
+        info.closed = True
+        self._streams.pop(packet.stream_id, None)
+        await info.local.channel.close()
+        for r in info.remotes:  # successors acked the CLOSE already
+            await r.close()
+        link_key = (info.request.client_id.to_bytes(), info.request.call_id)
+        self._links[link_key] = (info, time.monotonic())
+        if not info.is_primary:
+            return b""
+        reply = await self.server.submit_data_stream_request(info.request)
+        if not reply.success:
+            self._links.pop(link_key, None)
+            await self._cleanup(info)
+        return reply.to_bytes()
+
+    async def _cleanup(self, info: StreamInfo) -> None:
+        if info.local is not None:
+            try:
+                await info.local.cleanup()
+            except Exception:
+                LOG.exception("stream cleanup failed")
+        for r in info.remotes:
+            await r.close()
+
+    # ----------------------------------------------------- apply-time link
+
+    def take_link(self, client_id: bytes, call_id: int
+                  ) -> Optional[StreamInfo]:
+        entry = self._links.pop((client_id, call_id), None)
+        return entry[0] if entry is not None else None
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        return self.transport.bound_port

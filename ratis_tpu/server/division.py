@@ -884,6 +884,10 @@ class Division:
             return await self._watch_async(req)
         if t == RequestType.MESSAGE_STREAM:
             return await self._message_stream_async(req)
+        if t == RequestType.DATA_STREAM:
+            # the submit of a completed DataStream rides the write path; the
+            # streamed bytes are linked at apply (DataStreamManagement)
+            return await self._write_async(req)
         if t == RequestType.SET_CONFIGURATION:
             from ratis_tpu.server import admin
             return await admin.set_configuration(self, req)
@@ -1159,13 +1163,18 @@ class Division:
         except RaftException as e:
             return RaftClientReply.failure_reply(req, e)
         if write_req is self.message_stream_requests.RETIRED:
-            # re-sent end-of-request: the assembled write already ran; only
-            # the retry cache may answer (re-executing with just the final
-            # chunk would corrupt the payload)
+            # re-sent end-of-request: the assembled write already ran (or is
+            # still replicating); only the retry cache may answer —
+            # re-executing with just the final chunk would corrupt the
+            # payload.  Await an in-flight original like _write_async does.
             entry = self.retry_cache.get(req.client_id.to_bytes(),
                                          req.call_id)
-            if entry is not None and entry.done():
-                return await entry.future
+            if entry is not None and not entry.future.cancelled():
+                try:
+                    return await asyncio.shield(entry.future)
+                except asyncio.CancelledError:
+                    if not entry.future.cancelled():
+                        raise  # our caller was cancelled, not the entry
             return RaftClientReply.failure_reply(req, StreamException(
                 f"stream {req.type.stream_id}: already assembled but the "
                 "reply is no longer cached; restart the stream"))
@@ -1302,6 +1311,16 @@ class Division:
             if trx is None or trx.log_entry is None \
                     or trx.log_entry.term_index() != entry.term_index():
                 trx = TransactionContext(log_entry=entry)
+            # DataStream link (StateMachine.DataApi.link, §3.5): tie the
+            # bytes this peer streamed to the committed entry before apply.
+            if entry.smlog is not None and self.server.datastream is not None:
+                link = self.server.datastream.take_link(
+                    entry.smlog.client_id, entry.smlog.call_id)
+                if link is not None:
+                    try:
+                        await sm.data_link(link.local, entry)
+                    except Exception:
+                        LOG.exception("%s data_link failed", self.member_id)
             try:
                 reply_message = await sm.apply_transaction(trx)
                 self.sm_metrics.applied_count.inc()

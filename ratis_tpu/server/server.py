@@ -50,6 +50,7 @@ class RaftServer:
         self._sm_registry = state_machine_registry
         self._initial_group = group
         self._log_factory = log_factory
+        self._transport_factory = transport_factory
         self.life_cycle = LifeCycle(f"server-{peer_id}")
         self.divisions: dict[RaftGroupId, Division] = {}
         # Transaction contexts between append and apply
@@ -78,6 +79,18 @@ class RaftServer:
             peer_id, address, self._handle_server_rpc,
             self._handle_client_request, properties,
             peer_resolver=self.resolve_peer_address)
+
+        # DataStream bulk path (reference DataStreamServerImpl; served on the
+        # peer's dedicated datastream address when one is configured)
+        self.datastream = None
+        ds_address = None
+        if group is not None:
+            me = group.get_peer(peer_id)
+            if me is not None:
+                ds_address = me.datastream_address
+        if ds_address:
+            from ratis_tpu.server.datastream import DataStreamManagement
+            self.datastream = DataStreamManagement(self, ds_address)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -119,6 +132,8 @@ class RaftServer:
                 and self._initial_group.group_id not in self.divisions:
             await self._add_division(self._initial_group)
         await self.transport.start()
+        if self.datastream is not None:
+            await self.datastream.start()
         self.life_cycle.transition(LifeCycleState.RUNNING)
 
     async def close(self) -> None:
@@ -128,6 +143,8 @@ class RaftServer:
                     LifeCycleState.NEW, LifeCycleState.CLOSING):
                 return
         await self.transport.close()
+        if self.datastream is not None:
+            await self.datastream.close()
         for div in list(self.divisions.values()):
             await div.close()
         self.divisions.clear()
@@ -242,6 +259,35 @@ class RaftServer:
         except Exception as e:  # never leak raw errors to the wire
             LOG.exception("%s request failed", self.peer_id)
             return RaftClientReply.failure_reply(request, RaftException(str(e)))
+
+    async def submit_data_stream_request(self, request: RaftClientRequest
+                                         ) -> RaftClientReply:
+        """Primary-side raft submit of a completed DataStream
+        (DataStreamManagement.java:139-193: on CLOSE the primary drives the
+        header request through the ordinary consensus path).  The primary
+        may not be the leader — forward like any client request would be."""
+        try:
+            div = self.get_division(request.group_id)
+            reply = await div.submit_client_request(request)
+        except RaftException as e:
+            return RaftClientReply.failure_reply(request, e)
+        nle = reply.get_not_leader_exception()
+        if nle is not None and nle.suggested_leader is not None:
+            peer = nle.suggested_leader
+            address = peer.get_client_address() or \
+                self.resolve_peer_address(peer.id)
+            if address:
+                try:
+                    forward = self._transport_factory.new_client_transport(
+                        self.properties)
+                    try:
+                        return await forward.send_request(address, request)
+                    finally:
+                        await forward.close()
+                except Exception as e:
+                    return RaftClientReply.failure_reply(
+                        request, RaftException(f"forward to leader: {e}"))
+        return reply
 
     async def _group_management(self, request: RaftClientRequest
                                 ) -> RaftClientReply:

@@ -119,6 +119,34 @@ class StateMachineStorage:
             f.unlink(missing_ok=True)
 
 
+class DataChannel:
+    """Destination of one DataStream's bytes
+    (reference StateMachine.DataChannel:302 — a WritableByteChannel the SM
+    owns, e.g. an open file)."""
+
+    async def write(self, data: bytes) -> int:
+        raise NotImplementedError
+
+    async def force(self, metadata: bool = False) -> None:
+        """fsync-equivalent (DataChannel.force)."""
+
+    async def close(self) -> None:
+        pass
+
+
+class DataStream:
+    """One open stream handed out by :meth:`StateMachine.data_stream`
+    (reference StateMachine.DataStream:338): the channel plus cleanup."""
+
+    def __init__(self, channel: DataChannel, request=None) -> None:
+        self.channel = channel
+        self.request = request  # the header RaftClientRequest
+
+    async def cleanup(self) -> None:
+        """Discard resources after failure (DataStream.cleanUp)."""
+        await self.channel.close()
+
+
 class StateMachine:
     """Base class every application state machine extends.
 
@@ -261,6 +289,30 @@ class StateMachine:
 
     async def notify_not_leader(self, pending_requests: Iterable) -> None:
         pass
+
+    # ------------------------------------------------------------- DataApi
+    # Optional bulk-data sub-API (reference StateMachine.DataApi:69): stream
+    # bytes AROUND the raft log into SM-owned storage, then `link` ties the
+    # streamed data to the log entry at apply time (§3.5 of SURVEY.md).
+
+    async def data_stream(self, request) -> DataStream:
+        """Open a DataChannel for an incoming stream (DataApi.stream)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support DataStream")
+
+    async def data_link(self, stream: Optional[DataStream], entry) -> None:
+        """Tie a completed stream's data to its committed log entry
+        (DataApi.link); ``stream`` is None on peers that did not receive
+        the stream (they must fetch via ordinary replication/recovery)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support DataStream")
+
+    async def data_write(self, entry) -> None:
+        """Persist SM data carried by a log entry outside the log
+        (DataApi.write); default no-op."""
+
+    async def data_flush(self, index: int) -> None:
+        """Flush SM data up to a log index (DataApi.flush); default no-op."""
 
     def __str__(self) -> str:
         return f"{type(self).__name__}@{self.member_id}"

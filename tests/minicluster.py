@@ -77,8 +77,11 @@ class MiniCluster:
                     else RaftPeerRole.FOLLOWER)
             address = (f"127.0.0.1:{free_port()}" if self.rpc_type == "GRPC"
                        else f"sim:s{i}")
+            # DataStream rides real TCP regardless of the RPC transport
             peers.append(RaftPeer(RaftPeerId.value_of(f"s{i}"),
-                                  address=address, startup_role=role))
+                                  address=address,
+                                  datastream_address=f"127.0.0.1:{free_port()}",
+                                  startup_role=role))
         self.group = RaftGroup.value_of(RaftGroupId.random_id(), peers)
         self.servers: dict[RaftPeerId, RaftServer] = {}
         self._stopped: dict[RaftPeerId, RaftPeer] = {}

@@ -1,0 +1,184 @@
+"""DataStream tests (reference ratis-test datastream suites +
+TestNettyDataStream*: framing, routing, stream-write-link end to end)."""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from ratis_tpu.models.filestore import FileStoreStateMachine
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.routing import RoutingTable
+from ratis_tpu.transport.datastream import (FLAG_CLOSE, FLAG_PRIMARY,
+                                            FLAG_SYNC, KIND_DATA,
+                                            KIND_HEADER, Packet,
+                                            encode_packet, read_packet)
+from tests.minicluster import run_with_new_cluster
+
+
+def _pid(s):
+    return RaftPeerId.value_of(s)
+
+
+def test_packet_roundtrip():
+    async def _run():
+        p = Packet(KIND_DATA, 12345, 678, FLAG_SYNC | FLAG_CLOSE, b"payload")
+        reader = asyncio.StreamReader()
+        reader.feed_data(encode_packet(p))
+        reader.feed_eof()
+        q = await read_packet(reader)
+        assert q == p
+        assert q.is_sync and q.is_close
+        assert await read_packet(reader) is None  # clean EOF
+
+    asyncio.run(_run())
+
+
+def test_packet_truncation_raises():
+    async def _run():
+        p = Packet(KIND_HEADER, 1, 0, FLAG_PRIMARY, b"x" * 100)
+        raw = encode_packet(p)
+        reader = asyncio.StreamReader()
+        reader.feed_data(raw[:len(raw) - 5])
+        reader.feed_eof()
+        with pytest.raises((ConnectionError, asyncio.IncompleteReadError)):
+            await read_packet(reader)
+
+    asyncio.run(_run())
+
+
+def test_routing_table_shapes():
+    a, b, c = _pid("a"), _pid("b"), _pid("c")
+    chain = RoutingTable.chain([a, b, c])
+    assert chain.get_successors(a) == (b,)
+    assert chain.get_successors(b) == (c,)
+    assert chain.get_successors(c) == ()
+    star = RoutingTable.star(a, [b, c])
+    assert set(star.get_successors(a)) == {b, c}
+    rt = (RoutingTable.Builder().add_successor(a, b)
+          .add_successor(a, c).build())
+    assert rt.get_successors(a) == (b, c)
+    # wire round trip
+    assert RoutingTable.from_dict(rt.to_dict()) == rt
+
+
+def _stream_cmd(path):
+    return msgpack.packb({"op": "stream", "path": path}, use_bin_type=True)
+
+
+def test_filestore_stream_end_to_end():
+    """1MB streamed in 64KB packets lands identically on every peer."""
+
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        payload = bytes((i * 31) % 256 for i in range(1 << 20))
+        async with cluster.new_client() as client:
+            out = await client.data_stream().stream(_stream_cmd("big.bin"))
+            for i in range(0, len(payload), 64 << 10):
+                await out.write_async(payload[i:i + (64 << 10)])
+            reply = await out.close_async()
+            assert reply.success, reply.exception
+            result = msgpack.unpackb(reply.message.content, raw=False)
+            assert result == {"ok": True, "size": len(payload)}
+
+            # read back through a linearizable query
+            read = await client.io().send_read_only(
+                msgpack.packb({"op": "read", "path": "big.bin"},
+                              use_bin_type=True))
+            data = msgpack.unpackb(read.message.content, raw=False)["data"]
+            assert data == payload
+
+            await cluster.wait_applied(reply.log_index)
+        # every peer that received the stream has the identical file
+        found = 0
+        for div in cluster.divisions():
+            sm = div.state_machine
+            target = sm.resolve("big.bin")
+            if target.exists():
+                assert target.read_bytes() == payload
+                found += 1
+        assert found == len(cluster.divisions())  # star routing reaches all
+
+    run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
+
+
+def test_filestore_stream_via_follower_primary():
+    """Streaming to a non-leader primary still commits (forward to leader)."""
+
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        follower = next(d for d in cluster.divisions() if d.is_follower())
+        follower_peer = cluster.group.get_peer(follower.member_id.peer_id)
+        async with cluster.new_client() as client:
+            out = await client.data_stream().stream(
+                _stream_cmd("via-follower.bin"), primary=follower_peer)
+            await out.write_async(b"hello " * 1000)
+            reply = await out.close_async()
+            assert reply.success, reply.exception
+
+    run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
+
+
+def test_filestore_chain_routing():
+    """Chain topology: primary -> f1 -> f2; all peers get the bytes."""
+
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        order = [leader.member_id.peer_id] + \
+            [d.member_id.peer_id for d in cluster.divisions()
+             if d.member_id.peer_id != leader.member_id.peer_id]
+        rt = RoutingTable.chain(order)
+        leader_peer = cluster.group.get_peer(order[0])
+        payload = b"chained-data" * 5000
+        async with cluster.new_client() as client:
+            out = await client.data_stream().stream(
+                _stream_cmd("chain.bin"), routing_table=rt,
+                primary=leader_peer)
+            await out.write_async(payload)
+            reply = await out.close_async()
+            assert reply.success, reply.exception
+            await cluster.wait_applied(reply.log_index)
+        for div in cluster.divisions():
+            target = div.state_machine.resolve("chain.bin")
+            assert target.exists()
+            assert target.read_bytes() == payload
+
+    run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
+
+
+def test_filestore_write_read_delete():
+    """Small files through the ordinary log path."""
+
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            w = await client.io().send(msgpack.packb(
+                {"op": "write", "path": "small.txt", "data": b"contents"},
+                use_bin_type=True))
+            assert w.success
+            ls = await client.io().send_read_only(
+                msgpack.packb({"op": "list"}, use_bin_type=True))
+            assert msgpack.unpackb(ls.message.content,
+                                   raw=False)["files"] == ["small.txt"]
+            d = await client.io().send(msgpack.packb(
+                {"op": "delete", "path": "small.txt"}, use_bin_type=True))
+            assert d.success
+            ls = await client.io().send_read_only(
+                msgpack.packb({"op": "list"}, use_bin_type=True))
+            assert msgpack.unpackb(ls.message.content,
+                                   raw=False)["files"] == []
+
+    run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
+
+
+def test_filestore_rejects_unsafe_paths():
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for bad in ("../escape", "/abs/path", ""):
+                reply = await client.io().send(msgpack.packb(
+                    {"op": "write", "path": bad, "data": b"x"},
+                    use_bin_type=True))
+                assert not reply.success
+
+    run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)

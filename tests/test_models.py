@@ -26,6 +26,16 @@ def test_evaluate_undefined_variable():
         evaluate("x + 1", {})
 
 
+def test_evaluate_huge_pow_fails_fast():
+    """Operands are coerced to float, so tower exponents overflow instantly
+    instead of grinding the event loop on a bignum."""
+    import time
+    t0 = time.perf_counter()
+    with pytest.raises(OverflowError):
+        evaluate("10**10**10", {})
+    assert time.perf_counter() - t0 < 0.1
+
+
 def test_arithmetic_cluster_end_to_end():
     """Pythagorean demo from the reference README: a=3, b=4, c=sqrt(a²+b²)."""
 
