@@ -423,12 +423,15 @@ class DataStreamOutput:
         from ratis_tpu.transport.datastream import (FLAG_PRIMARY, KIND_HEADER,
                                                     Packet, encode_header)
         await self._conn.connect()
-        header = Packet(KIND_HEADER, self._stream_id, 0, FLAG_PRIMARY,
-                        encode_header(self.request, self.routing))
-        ack = await (await self._conn.send(header))
-        if not ack.success:
+        try:
+            header = Packet(KIND_HEADER, self._stream_id, 0, FLAG_PRIMARY,
+                            encode_header(self.request, self.routing))
+            ack = await (await self._conn.send(header))
+            if not ack.success:
+                raise RaftException("datastream header rejected by primary")
+        except BaseException:
             await self._conn.close()
-            raise RaftException("datastream header rejected by primary")
+            raise
 
     async def write_async(self, data: bytes, sync: bool = False) -> None:
         from ratis_tpu.transport.datastream import (FLAG_SYNC, KIND_DATA,
@@ -491,6 +494,16 @@ class DataStreamApi:
         if primary is None:
             candidates = [p for p in c._peers.values()
                           if p.datastream_address]
+            if not candidates:
+                # the caller's peer list may carry RPC addresses only (e.g.
+                # a CLI -peers spec); learn the full peer records — incl.
+                # datastream addresses — from the group like the reference
+                # client does via GroupInfo
+                info = await c.group_management().group_info(
+                    next(iter(c._peers)), c.group_id)
+                c._update_peers(info.group.peers)
+                candidates = [p for p in c._peers.values()
+                              if p.datastream_address]
             if not candidates:
                 raise RaftException("no peer has a datastream address")
             leader = c._peers.get(c._leader_id) if c._leader_id else None

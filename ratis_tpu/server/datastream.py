@@ -90,6 +90,7 @@ class DataStreamManagement:
         # pin temp files/FDs on followers forever
         self._links: Dict[LinkKey, Tuple[StreamInfo, float]] = {}
         self._expiry_s = expiry_s
+        self._last_sweep_s = time.monotonic()
 
     async def start(self) -> None:
         await self.transport.start()
@@ -110,7 +111,11 @@ class DataStreamManagement:
         raft entry never applied (lazy sweep, cf. MessageStreamRequests)."""
         if self._expiry_s <= 0:
             return
-        deadline = time.monotonic() - self._expiry_s
+        now = time.monotonic()
+        if now - self._last_sweep_s < self._expiry_s / 10:
+            return  # keep the per-packet hot path O(1)
+        self._last_sweep_s = now
+        deadline = now - self._expiry_s
         for sid in [s for s, i in self._streams.items()
                     if i.touched_s < deadline]:
             info = self._streams.pop(sid)
@@ -152,6 +157,12 @@ class DataStreamManagement:
 
         remotes: list[_RemoteStream] = []
         successors = routing.get_successors(self.server.peer_id)
+        if routing.is_empty() and is_primary:
+            # documented default: an empty table means the primary fans out
+            # to every other peer that serves a datastream address
+            successors = tuple(
+                p.id for p in division.state.configuration.all_peers()
+                if p.id != self.server.peer_id and p.datastream_address)
         for pid in successors:
             peer = division.state.configuration.get_peer(pid)
             if peer is None or not peer.datastream_address:

@@ -81,16 +81,13 @@ class RaftServer:
             peer_resolver=self.resolve_peer_address)
 
         # DataStream bulk path (reference DataStreamServerImpl; served on the
-        # peer's dedicated datastream address when one is configured)
+        # peer's dedicated datastream address when one is configured).  Also
+        # created lazily by _add_division for groups that arrive via
+        # group_add after startup.
         self.datastream = None
-        ds_address = None
+        self._datastream_started = False
         if group is not None:
-            me = group.get_peer(peer_id)
-            if me is not None:
-                ds_address = me.datastream_address
-        if ds_address:
-            from ratis_tpu.server.datastream import DataStreamManagement
-            self.datastream = DataStreamManagement(self, ds_address)
+            self._maybe_create_datastream(group)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -132,8 +129,9 @@ class RaftServer:
                 and self._initial_group.group_id not in self.divisions:
             await self._add_division(self._initial_group)
         await self.transport.start()
-        if self.datastream is not None:
+        if self.datastream is not None and not self._datastream_started:
             await self.datastream.start()
+            self._datastream_started = True
         self.life_cycle.transition(LifeCycleState.RUNNING)
 
     async def close(self) -> None:
@@ -153,9 +151,25 @@ class RaftServer:
 
     # -------------------------------------------------------- group mgmt
 
+    def _maybe_create_datastream(self, group: RaftGroup) -> None:
+        if self.datastream is not None:
+            return
+        me = group.get_peer(self.peer_id)
+        if me is not None and me.datastream_address:
+            from ratis_tpu.server.datastream import DataStreamManagement
+            self.datastream = DataStreamManagement(self,
+                                                   me.datastream_address)
+
     async def _add_division(self, group: RaftGroup) -> Division:
         if group.group_id in self.divisions:
             raise AlreadyExistsException(f"{self.peer_id} already hosts {group.group_id}")
+        # a group arriving after startup (group_add) may be the first to
+        # advertise a datastream address for this peer
+        self._maybe_create_datastream(group)
+        if self.datastream is not None and not self._datastream_started \
+                and self.life_cycle.get_current_state() == LifeCycleState.RUNNING:
+            await self.datastream.start()
+            self._datastream_started = True
         sm = self._sm_registry(group.group_id)
         storage = None
         log = None

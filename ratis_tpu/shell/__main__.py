@@ -1,0 +1,8 @@
+"""``python -m ratis_tpu.shell`` — the admin CLI entry point
+(reference ratis-shell/src/main/bin + RatisShell.main:60)."""
+
+import sys
+
+from ratis_tpu.shell.cli import main
+
+sys.exit(main())

@@ -1,0 +1,335 @@
+"""Admin shell: the operator CLI over the client admin APIs.
+
+Capability parity with the reference ratis-shell
+(ratis-shell/src/main/java/org/apache/ratis/shell/cli/sh/RatisShell.java:60
+and its command tree): ``election {transfer,stepDown,pause,resume}``,
+``group {info,list}``, ``peer {add,remove,setPriority}``,
+``snapshot create``, and the offline ``local raftMetaConf`` rewriter.
+
+Usage (mirrors the reference flags):
+  python -m ratis_tpu.shell election transfer -peers s0=h:p,s1=h:p -peerId s1
+  python -m ratis_tpu.shell group info -peers s0=h:p,s1=h:p [-groupid UUID]
+  python -m ratis_tpu.shell peer add -peers ... -peerId s3 -address h:p
+  python -m ratis_tpu.shell local raftMetaConf -path <dir> -peers s0=h:p,...
+
+``-peers`` entries are ``id=host:port`` (or bare ``host:port``, id derived
+from the address like the reference's getPeerId).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from typing import List, Optional
+
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer
+
+
+def parse_peers(spec: str) -> List[RaftPeer]:
+    peers = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            pid, _, address = part.partition("=")
+        else:
+            address = part
+            pid = address.replace(":", "_").replace(".", "_")
+        peers.append(RaftPeer(RaftPeerId.value_of(pid), address=address))
+    if not peers:
+        raise ValueError(f"no peers in {spec!r}")
+    return peers
+
+
+def _new_client(peers: List[RaftPeer], group_id: Optional[RaftGroupId]):
+    from ratis_tpu.client import RaftClient
+    from ratis_tpu.transport import grpc as _grpc  # noqa: F401 (registers)
+    from ratis_tpu.transport.base import TransportFactory
+    factory = TransportFactory.get("GRPC")
+    group = RaftGroup.value_of(group_id or RaftGroupId.empty_id(), peers)
+    return (RaftClient.builder()
+            .set_raft_group(group)
+            .set_transport(factory.new_client_transport())
+            .build())
+
+
+async def _resolve_group(args) -> tuple:
+    """(peers, group_id): use -groupid, else ask a server for its groups
+    (reference GroupListCommand-assisted default)."""
+    peers = parse_peers(args.peers)
+    if args.groupid:
+        return peers, RaftGroupId.value_of(args.groupid)
+    async with _new_client(peers, None) as probe:
+        groups = await probe.group_management().group_list(peers[0].id)
+    if len(groups) != 1:
+        raise SystemExit(
+            f"server hosts {len(groups)} groups "
+            f"({', '.join(str(g) for g in groups)}); pass -groupid")
+    return peers, groups[0]
+
+
+def _target_peer_id(args, peers) -> RaftPeerId:
+    if getattr(args, "peerId", None):
+        return RaftPeerId.value_of(args.peerId)
+    if getattr(args, "address", None):
+        for p in peers:
+            if p.address == args.address:
+                return p.id
+        raise SystemExit(f"address {args.address} not in -peers")
+    raise SystemExit("pass -peerId or -address")
+
+
+# ------------------------------------------------------------- commands
+
+async def cmd_group_list(args) -> int:
+    peers = parse_peers(args.peers)
+    target = _target_peer_id(args, peers) if (args.peerId or args.address) \
+        else peers[0].id
+    async with _new_client(peers, None) as client:
+        groups = await client.group_management().group_list(target)
+    print(f"{target}: {len(groups)} group(s)")
+    for gid in groups:
+        print(f"  {gid.uuid}")
+    return 0
+
+
+async def cmd_group_info(args) -> int:
+    peers, gid = await _resolve_group(args)
+    async with _new_client(peers, gid) as client:
+        info = await client.group_management().group_info(peers[0].id, gid)
+    print(f"group id: {info.group.group_id.uuid}")
+    print(f"leader: {info.leader_id or '<none>'} (term {info.term})")
+    print(f"commit index: {info.commit_index}  "
+          f"applied index: {info.applied_index}")
+    for p in info.group.peers:
+        print(f"  peer {p.id} | {p.address} | priority={p.priority}"
+              f"{' | LISTENER' if p.is_listener() else ''}")
+    return 0
+
+
+async def cmd_election_transfer(args) -> int:
+    peers, gid = await _resolve_group(args)
+    target = _target_peer_id(args, peers)
+    async with _new_client(peers, gid) as client:
+        reply = await client.admin().transfer_leadership(
+            target, timeout_ms=args.timeout * 1000.0)
+    print(f"leadership transfer to {target}: "
+          f"{'SUCCESS' if reply.success else reply.exception}")
+    return 0 if reply.success else 1
+
+
+async def cmd_election_step_down(args) -> int:
+    peers, gid = await _resolve_group(args)
+    async with _new_client(peers, gid) as client:
+        reply = await client.admin().transfer_leadership(None)
+    print(f"step down: {'SUCCESS' if reply.success else reply.exception}")
+    return 0 if reply.success else 1
+
+
+async def _election_pause_resume(args, op: str) -> int:
+    peers, gid = await _resolve_group(args)
+    target = _target_peer_id(args, peers)
+    async with _new_client(peers, gid) as client:
+        api = client.leader_election_management()
+        reply = await (api.pause(target) if op == "pause"
+                       else api.resume(target))
+    print(f"election {op} on {target}: "
+          f"{'SUCCESS' if reply.success else reply.exception}")
+    return 0 if reply.success else 1
+
+
+async def cmd_peer_add(args) -> int:
+    from ratis_tpu.protocol.admin import SetConfigurationMode
+    peers, gid = await _resolve_group(args)
+    new_peer = RaftPeer(RaftPeerId.value_of(args.peerId),
+                        address=args.address)
+    async with _new_client(peers, gid) as client:
+        info = await client.group_management().group_info(peers[0].id, gid)
+        current = [p for p in info.group.peers if not p.is_listener()]
+        if any(p.id == new_peer.id for p in current):
+            print(f"peer {new_peer.id} already in the group")
+            return 1
+        reply = await client.admin().set_configuration(
+            current + [new_peer], mode=SetConfigurationMode.SET_UNCONDITIONALLY)
+    print(f"peer add {new_peer.id}: "
+          f"{'SUCCESS' if reply.success else reply.exception}")
+    return 0 if reply.success else 1
+
+
+async def cmd_peer_remove(args) -> int:
+    from ratis_tpu.protocol.admin import SetConfigurationMode
+    peers, gid = await _resolve_group(args)
+    victim = _target_peer_id(args, peers)
+    async with _new_client(peers, gid) as client:
+        info = await client.group_management().group_info(peers[0].id, gid)
+        current = [p for p in info.group.peers if not p.is_listener()]
+        remaining = [p for p in current if p.id != victim]
+        if len(remaining) == len(current):
+            print(f"peer {victim} not in the group")
+            return 1
+        reply = await client.admin().set_configuration(
+            remaining, mode=SetConfigurationMode.SET_UNCONDITIONALLY)
+    print(f"peer remove {victim}: "
+          f"{'SUCCESS' if reply.success else reply.exception}")
+    return 0 if reply.success else 1
+
+
+async def cmd_peer_set_priority(args) -> int:
+    from ratis_tpu.protocol.admin import SetConfigurationMode
+    peers, gid = await _resolve_group(args)
+    updates = {}
+    for spec in args.addressPriority:
+        address, _, prio = spec.rpartition("|")
+        updates[address] = int(prio)
+    async with _new_client(peers, gid) as client:
+        info = await client.group_management().group_info(peers[0].id, gid)
+        new_conf = []
+        for p in info.group.peers:
+            if p.is_listener():
+                continue
+            new_conf.append(p.with_priority(updates[p.address])
+                            if p.address in updates else p)
+        reply = await client.admin().set_configuration(new_conf)
+    print(f"setPriority: {'SUCCESS' if reply.success else reply.exception}")
+    return 0 if reply.success else 1
+
+
+async def cmd_snapshot_create(args) -> int:
+    peers, gid = await _resolve_group(args)
+    target = (_target_peer_id(args, peers)
+              if (args.peerId or args.address) else None)
+    async with _new_client(peers, gid) as client:
+        reply = await client.snapshot_management().create(
+            creation_gap=args.creationGap, server_id=target)
+    if reply.success:
+        print(f"snapshot created at index {reply.log_index}")
+        return 0
+    print(f"snapshot create failed: {reply.exception}")
+    return 1
+
+
+def cmd_local_raft_meta_conf(args) -> int:
+    """Offline rewrite of raft-meta.conf to a new peer list (reference
+    `local raftMetaConf`, used to resurrect a group whose quorum is gone)."""
+    import pathlib
+
+    from ratis_tpu.protocol.logentry import LogEntry, make_config_entry
+    from ratis_tpu.server.storage import RaftStorageDirectory
+    peers = parse_peers(args.peers)
+    path = pathlib.Path(args.path)
+    conf_file = path / RaftStorageDirectory.CONF_FILE
+    if not conf_file.exists():
+        print(f"no {RaftStorageDirectory.CONF_FILE} under {path}",
+              file=sys.stderr)
+        return 1
+    old = LogEntry.from_bytes(conf_file.read_bytes())
+    new_entry = make_config_entry(old.term, old.index + 1, peers)
+    backup = conf_file.with_suffix(".conf.bak")
+    backup.write_bytes(conf_file.read_bytes())
+    tmp = conf_file.with_suffix(".conf.tmp")
+    tmp.write_bytes(new_entry.to_bytes())
+    tmp.replace(conf_file)
+    print(f"rewrote {conf_file} at index {new_entry.index} with "
+          f"{len(peers)} peer(s); backup at {backup}")
+    return 0
+
+
+# -------------------------------------------------------------- parser
+
+def _add_common(p: argparse.ArgumentParser, group_opt: bool = True) -> None:
+    p.add_argument("-peers", required=True,
+                   help="comma list of id=host:port")
+    if group_opt:
+        p.add_argument("-groupid", default=None, help="group UUID")
+
+
+def _add_target(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-peerId", default=None)
+    p.add_argument("-address", default=None)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="ratis sh", description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    g = sub.add_parser("group").add_subparsers(dest="sub", required=True)
+    p = g.add_parser("list")
+    _add_common(p, group_opt=False)
+    _add_target(p)
+    p.set_defaults(func=cmd_group_list)
+    p = g.add_parser("info")
+    _add_common(p)
+    p.set_defaults(func=cmd_group_info)
+
+    e = sub.add_parser("election").add_subparsers(dest="sub", required=True)
+    p = e.add_parser("transfer")
+    _add_common(p)
+    _add_target(p)
+    p.add_argument("-timeout", type=float, default=10.0, help="seconds")
+    p.set_defaults(func=cmd_election_transfer)
+    p = e.add_parser("stepDown")
+    _add_common(p)
+    p.set_defaults(func=cmd_election_step_down)
+    p = e.add_parser("pause")
+    _add_common(p)
+    _add_target(p)
+    p.set_defaults(func=lambda a: _election_pause_resume(a, "pause"))
+    p = e.add_parser("resume")
+    _add_common(p)
+    _add_target(p)
+    p.set_defaults(func=lambda a: _election_pause_resume(a, "resume"))
+
+    pe = sub.add_parser("peer").add_subparsers(dest="sub", required=True)
+    p = pe.add_parser("add")
+    _add_common(p)
+    p.add_argument("-peerId", required=True)
+    p.add_argument("-address", required=True)
+    p.set_defaults(func=cmd_peer_add)
+    p = pe.add_parser("remove")
+    _add_common(p)
+    _add_target(p)
+    p.set_defaults(func=cmd_peer_remove)
+    p = pe.add_parser("setPriority")
+    _add_common(p)
+    p.add_argument("-addressPriority", nargs="+", required=True,
+                   metavar="host:port|priority")
+    p.set_defaults(func=cmd_peer_set_priority)
+
+    s = sub.add_parser("snapshot").add_subparsers(dest="sub", required=True)
+    p = s.add_parser("create")
+    _add_common(p)
+    _add_target(p)
+    p.add_argument("-creationGap", type=int, default=0)
+    p.set_defaults(func=cmd_snapshot_create)
+
+    lo = sub.add_parser("local").add_subparsers(dest="sub", required=True)
+    p = lo.add_parser("raftMetaConf")
+    p.add_argument("-path", required=True,
+                   help="the group's `current/` storage dir")
+    p.add_argument("-peers", required=True)
+    p.set_defaults(func=cmd_local_raft_meta_conf, sync=True)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    func = args.func
+    if getattr(args, "sync", False):
+        return func(args)
+    try:
+        return asyncio.run(func(args))
+    except SystemExit:
+        raise
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -189,6 +189,7 @@ class DataStreamConnection:
         self._pending: dict[tuple, asyncio.Future] = {}
         self._recv_task: Optional[asyncio.Task] = None
         self._send_lock = asyncio.Lock()
+        self._dead: Optional[Exception] = None
 
     async def connect(self) -> None:
         host, port = self.address.rsplit(":", 1)
@@ -198,24 +199,30 @@ class DataStreamConnection:
             self._recv_loop(), name=f"datastream-recv-{self.address}")
 
     async def _recv_loop(self) -> None:
+        cause: Exception = ConnectionError(
+            f"datastream connection to {self.address} closed")
         try:
             while True:
                 packet = await read_packet(self._reader)
                 if packet is None:
-                    break
+                    break  # clean EOF still fails whatever is outstanding
                 key = (packet.stream_id, packet.offset, packet.is_close)
                 fut = self._pending.pop(key, None)
                 if fut is not None and not fut.done():
                     fut.set_result(packet)
         except (ConnectionError, OSError, asyncio.CancelledError) as e:
+            cause = ConnectionError(f"datastream connection lost: {e}")
+        finally:
+            self._dead = cause
             for fut in self._pending.values():
                 if not fut.done():
-                    fut.set_exception(
-                        ConnectionError(f"datastream connection lost: {e}"))
+                    fut.set_exception(cause)
             self._pending.clear()
 
     async def send(self, packet: Packet) -> "asyncio.Future[Packet]":
         """Send one packet; returns the future of its REPLY packet."""
+        if self._dead is not None:
+            raise self._dead
         key = (packet.stream_id, packet.offset, packet.is_close)
         if key in self._pending:
             raise ConnectionError(

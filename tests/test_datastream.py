@@ -146,6 +146,28 @@ def test_filestore_chain_routing():
     run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
 
 
+def test_filestore_empty_routing_defaults_to_fanout():
+    """An explicitly empty RoutingTable means 'primary fans out to all'."""
+
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        leader_peer = cluster.group.get_peer(leader.member_id.peer_id)
+        payload = b"fanout" * 10000
+        async with cluster.new_client() as client:
+            out = await client.data_stream().stream(
+                _stream_cmd("fanout.bin"), routing_table=RoutingTable(),
+                primary=leader_peer)
+            await out.write_async(payload)
+            reply = await out.close_async()
+            assert reply.success, reply.exception
+            await cluster.wait_applied(reply.log_index)
+        for div in cluster.divisions():
+            assert div.state_machine.resolve("fanout.bin").read_bytes() \
+                == payload
+
+    run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
+
+
 def test_filestore_write_read_delete():
     """Small files through the ordinary log path."""
 

@@ -1,0 +1,143 @@
+"""Shell CLI + tools tests (reference ratis-test shell suites
+ratis-test/src/test/.../shell/cli/sh/ and ratis-tools ParseRatisLog)."""
+
+import io
+import subprocess
+import sys
+
+import pytest
+
+from ratis_tpu.shell.cli import build_parser, parse_peers
+from tests.minicluster import run_with_new_cluster
+
+
+def _peer_spec(cluster):
+    return ",".join(f"{p.id}={p.address}" for p in cluster.group.peers)
+
+
+def _parse(argv):
+    return build_parser().parse_args(argv)
+
+
+def test_parse_peers_forms():
+    peers = parse_peers("s0=h1:1,s1=h2:2")
+    assert [str(p.id) for p in peers] == ["s0", "s1"]
+    assert peers[0].address == "h1:1"
+    bare = parse_peers("10.0.0.1:9000")
+    assert bare[0].address == "10.0.0.1:9000"
+    assert str(bare[0].id) == "10_0_0_1_9000"
+    with pytest.raises(ValueError):
+        parse_peers("  ,  ")
+
+
+def test_shell_group_and_election_commands():
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        spec = _peer_spec(cluster)
+        gid = str(cluster.group.group_id.uuid)
+
+        args = _parse(["group", "list", "-peers", spec])
+        assert await args.func(args) == 0
+
+        args = _parse(["group", "info", "-peers", spec, "-groupid", gid])
+        assert await args.func(args) == 0
+
+        # group id auto-discovery (single group)
+        args = _parse(["group", "info", "-peers", spec])
+        assert await args.func(args) == 0
+
+        # transfer leadership to a follower by peer id
+        follower = next(d for d in cluster.divisions() if d.is_follower())
+        args = _parse(["election", "transfer", "-peers", spec,
+                       "-peerId", str(follower.member_id.peer_id),
+                       "-groupid", gid])
+        assert await args.func(args) == 0
+        new_leader = await cluster.wait_for_leader()
+        assert new_leader.member_id.peer_id == follower.member_id.peer_id
+
+        # pause + resume elections on a follower
+        f2 = next(d for d in cluster.divisions() if d.is_follower())
+        args = _parse(["election", "pause", "-peers", spec,
+                       "-peerId", str(f2.member_id.peer_id),
+                       "-groupid", gid])
+        assert await args.func(args) == 0
+        args = _parse(["election", "resume", "-peers", spec,
+                       "-peerId", str(f2.member_id.peer_id),
+                       "-groupid", gid])
+        assert await args.func(args) == 0
+
+    run_with_new_cluster(3, _test, rpc_type="GRPC")
+
+
+def test_shell_snapshot_create(tmp_path):
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        for _ in range(3):
+            reply = await cluster.send_write(b"INCREMENT")
+            assert reply.success
+        spec = _peer_spec(cluster)
+        args = _parse(["snapshot", "create", "-peers", spec])
+        assert await args.func(args) == 0
+
+    run_with_new_cluster(3, _test, rpc_type="GRPC",
+                         storage_root=str(tmp_path))
+
+
+def test_shell_main_subprocess():
+    """The real entry point: python -m ratis_tpu.shell against a live
+    cluster from another process."""
+
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        spec = _peer_spec(cluster)
+        proc = await __import__("asyncio").create_subprocess_exec(
+            sys.executable, "-m", "ratis_tpu.shell", "group", "info",
+            "-peers", spec,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+                 "JAX_PLATFORMS": "cpu"})
+        out, err = await proc.communicate()
+        assert proc.returncode == 0, err.decode()
+        text = out.decode()
+        assert "leader:" in text and "commit index:" in text
+
+    run_with_new_cluster(3, _test, rpc_type="GRPC")
+
+
+def test_parse_log_tool(tmp_path):
+    from ratis_tpu.tools.parse_log import dump_segment
+
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        for _ in range(5):
+            reply = await cluster.send_write(b"INCREMENT")
+            assert reply.success
+
+    run_with_new_cluster(3, _test, storage_root=str(tmp_path))
+    segments = list(tmp_path.rglob("log_*"))
+    assert segments
+    lines = []
+    total = sum(dump_segment(str(s), out=lines.append) for s in segments)
+    assert total >= 5
+    text = "\n".join(lines)
+    assert "STATE_MACHINE" in text and "CONFIGURATION" in text
+
+
+def test_local_raft_meta_conf(tmp_path):
+    async def _test(cluster):
+        await cluster.wait_for_leader()
+        reply = await cluster.send_write(b"INCREMENT")
+        assert reply.success
+
+    run_with_new_cluster(3, _test, storage_root=str(tmp_path))
+    conf_files = list(tmp_path.rglob("raft-meta.conf"))
+    assert conf_files
+    current_dir = conf_files[0].parent
+    args = _parse(["local", "raftMetaConf", "-path", str(current_dir),
+                   "-peers", "n0=h1:1,n1=h2:2,n2=h3:3"])
+    assert args.func(args) == 0  # sync command
+    from ratis_tpu.protocol.logentry import LogEntry
+    rewritten = LogEntry.from_bytes(conf_files[0].read_bytes())
+    assert sorted(str(p.id) for p in rewritten.conf.peers) == \
+        ["n0", "n1", "n2"]
+    assert (current_dir / "raft-meta.conf.bak").exists()
