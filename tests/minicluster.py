@@ -60,11 +60,12 @@ class MiniCluster:
         if storage_root is not None:
             RaftServerConfigKeys.Log.set_use_memory(self.properties, False)
         self.rpc_type = rpc_type.upper()
-        if self.rpc_type == "GRPC":
+        if self.rpc_type in ("GRPC", "NETTY", "TCP"):
             from ratis_tpu.transport import grpc as grpc_transport  # registers
+            from ratis_tpu.transport import tcp as tcp_transport  # registers
             from ratis_tpu.transport.base import TransportFactory
             self.network = None
-            self.factory = TransportFactory.get("GRPC")
+            self.factory = TransportFactory.get(self.rpc_type)
         else:
             self.network = SimulatedNetwork()
             self.factory = SimulatedTransportFactory(self.network)
@@ -75,7 +76,7 @@ class MiniCluster:
         for i in range(num_servers + num_listeners):
             role = (RaftPeerRole.LISTENER if i >= num_servers
                     else RaftPeerRole.FOLLOWER)
-            address = (f"127.0.0.1:{free_port()}" if self.rpc_type == "GRPC"
+            address = (f"127.0.0.1:{free_port()}" if self.network is None
                        else f"sim:s{i}")
             # DataStream rides real TCP regardless of the RPC transport
             peers.append(RaftPeer(RaftPeerId.value_of(f"s{i}"),

@@ -1,0 +1,121 @@
+"""Core Raft scenarios over the raw-TCP (netty-analog) transport.
+
+Mirrors the reference per-transport suite instantiation (ratis-test
+TestRaftWithNetty): the same behaviors as the gRPC suite, over the
+envelope-union TCP backend (ratis_tpu.transport.tcp)."""
+
+import asyncio
+
+import msgpack
+
+from minicluster import MiniCluster, free_port, run_with_new_cluster
+from ratis_tpu.models.filestore import FileStoreStateMachine
+from ratis_tpu.protocol.admin import SetConfigurationMode
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer
+
+RPC = "NETTY"
+
+
+def test_tcp_write_read():
+    async def t(cluster: MiniCluster):
+        async with cluster.new_client() as client:
+            for _ in range(5):
+                assert (await client.io().send(b"INCREMENT")).success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"5"
+
+    run_with_new_cluster(3, t, rpc_type=RPC)
+
+
+def test_tcp_leader_kill_failover():
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            assert (await client.io().send(b"INCREMENT")).success
+            await cluster.kill_server(leader.member_id.peer_id)
+            await cluster.wait_for_leader()
+            assert (await client.io().send(b"INCREMENT")).success
+            r = await client.io().send_read_only(b"GET")
+            assert r.message.content == b"2"
+
+    run_with_new_cluster(3, t, rpc_type=RPC)
+
+
+def test_tcp_restart_rejoins():
+    async def t(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        victim = next(d for d in cluster.divisions() if not d.is_leader())
+        vid = victim.member_id.peer_id
+        async with cluster.new_client() as client:
+            assert (await client.io().send(b"INCREMENT")).success
+            await cluster.kill_server(vid)
+            assert (await client.io().send(b"INCREMENT")).success
+            await cluster.restart_server(vid)
+            r = await client.io().send(b"INCREMENT")
+            assert r.success
+            await cluster.wait_applied(r.log_index)
+
+    run_with_new_cluster(3, t, rpc_type=RPC)
+
+
+def test_tcp_add_peer_and_transfer():
+    async def t(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            assert (await client.io().send(b"INCREMENT")).success
+            p = RaftPeer(RaftPeerId.value_of("g1"),
+                         address=f"127.0.0.1:{free_port()}")
+            await cluster.add_new_server(p)
+            empty = RaftGroup.value_of(cluster.group.group_id, [])
+            assert (await client.group_management().group_add(empty, p)).success
+            r = await client.admin().set_configuration(
+                [p], mode=SetConfigurationMode.ADD)
+            assert r.success, r
+            r = await client.admin().transfer_leadership(p.id,
+                                                         timeout_ms=8000.0)
+            assert r.success, r
+            assert (await client.io().send(b"INCREMENT")).success
+
+    run_with_new_cluster(3, t, rpc_type=RPC)
+
+
+def test_tcp_watch_and_stale_read():
+    async def t(cluster: MiniCluster):
+        from ratis_tpu.protocol.requests import ReplicationLevel
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            r = await client.io().send(b"INCREMENT")
+            assert r.success
+            w = await client.io().watch(r.log_index, ReplicationLevel.ALL)
+            assert w.success
+            await cluster.wait_applied(r.log_index)
+            follower = next(d for d in cluster.divisions()
+                            if not d.is_leader())
+            sr = await client.io().send_stale_read(
+                b"GET", r.log_index, follower.member_id.peer_id)
+            assert sr.success and sr.message.content == b"1"
+
+    run_with_new_cluster(3, t, rpc_type=RPC)
+
+
+def test_tcp_datastream_combo():
+    """RpcType TCP + DataStream — the reference's netty/netty combination
+    (MiniRaftClusterWithRpcTypeNettyAndDataStreamTypeNetty)."""
+
+    async def t(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        payload = b"tcp-combo" * 20000
+        async with cluster.new_client() as client:
+            out = await client.data_stream().stream(msgpack.packb(
+                {"op": "stream", "path": "combo.bin"}, use_bin_type=True))
+            await out.write_async(payload)
+            reply = await out.close_async()
+            assert reply.success, reply.exception
+            await cluster.wait_applied(reply.log_index)
+        for div in cluster.divisions():
+            assert div.state_machine.resolve("combo.bin").read_bytes() \
+                == payload
+
+    run_with_new_cluster(3, t, rpc_type=RPC, sm_factory=FileStoreStateMachine)
