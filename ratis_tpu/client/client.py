@@ -455,18 +455,35 @@ class DataStreamOutput:
         if self._closed:
             raise RaftException("stream already closed")
         self._closed = True
+        # Bound the whole drain+close (including the close packet's socket
+        # write, which can block on a stalled primary's full receive buffer)
+        # on ONE deadline derived from the header request's timeout.
+        timeout_s = (self.request.timeout_ms or 30_000.0) / 1000.0
+        deadline = asyncio.get_event_loop().time() + timeout_s
+
+        def remaining() -> float:
+            return max(0.001, deadline - asyncio.get_event_loop().time())
+
+        async def _send_close_and_wait(pkt):
+            return await (await self._conn.send(pkt))
+
         try:
-            acks = await asyncio.gather(*self._acks)
+            acks = await asyncio.wait_for(
+                asyncio.gather(*self._acks), remaining())
             for ack in acks:
                 if not ack.success:
                     raise RaftException(
                         f"datastream packet at offset {ack.offset} failed")
             close_pkt = Packet(KIND_DATA, self._stream_id, self._offset,
                                FLAG_CLOSE, b"")
-            final = await (await self._conn.send(close_pkt))
+            final = await asyncio.wait_for(
+                _send_close_and_wait(close_pkt), remaining())
             if not final.success or not final.data:
                 raise RaftException("datastream close rejected")
             return RaftClientReply.from_bytes(final.data)
+        except asyncio.TimeoutError:
+            raise RaftException(
+                f"datastream close timed out after {timeout_s}s") from None
         finally:
             await self._conn.close()
 

@@ -16,7 +16,7 @@ import ast
 import asyncio
 import math
 import operator
-import pickle
+import msgpack
 from typing import Dict
 
 from ratis_tpu.protocol.message import Message
@@ -33,6 +33,19 @@ _BINOPS = {
 }
 _UNARYOPS = {ast.USub: operator.neg, ast.UAdd: operator.pos}
 _FUNCS = {"sqrt": math.sqrt}
+
+
+def _encode_value(v):
+    if isinstance(v, complex):
+        return {"__complex__": [v.real, v.imag]}
+    raise TypeError(f"unserializable snapshot value {v!r}")
+
+
+def _decode_value(v):
+    if isinstance(v, dict) and "__complex__" in v:
+        re_, im = v["__complex__"]
+        return complex(re_, im)
+    return v
 
 
 def evaluate(expression: str, variables: Dict[str, float]) -> float:
@@ -116,7 +129,11 @@ class ArithmeticStateMachine(BaseStateMachine):
         if storage.directory is None:
             return -1  # volatile group: nothing durable to snapshot to
         path = storage.snapshot_path(ti.term, ti.index)
-        data = pickle.dumps(dict(self.variables))
+        # msgpack, not pickle: snapshot files can be installed over the
+        # network from another peer, so the format must not execute code.
+        # evaluate() can yield complex (e.g. (-2) ** 0.5) — tag those.
+        data = msgpack.packb(dict(self.variables), use_bin_type=True,
+                             default=_encode_value)
         await asyncio.to_thread(self._write_snapshot, path, data)
         return ti.index
 
@@ -131,5 +148,11 @@ class ArithmeticStateMachine(BaseStateMachine):
             return
         import pathlib
         data = pathlib.Path(snapshot.files[0].path).read_bytes()
-        self.variables = pickle.loads(data)
+        try:
+            raw = msgpack.unpackb(data, raw=False, strict_map_key=False)
+        except Exception as e:
+            raise ValueError(
+                "arithmetic snapshot is not msgpack (unsupported legacy "
+                "format?): " + str(e)) from e
+        self.variables = {k: _decode_value(v) for k, v in raw.items()}
         self.set_last_applied_term_index(snapshot.term_index)

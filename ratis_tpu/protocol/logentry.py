@@ -39,6 +39,11 @@ class StateMachineLogEntry:
     # provides a DataApi (reference SegmentedRaftLog stateMachineCachingEnabled,
     # SegmentedRaftLog.java:203).  Not serialized into segment files.
     sm_data: Optional[bytes] = None
+    # True when this transaction was submitted by a DataStream CLOSE: every
+    # replica must data_link the entry at apply, passing None when it holds
+    # no local stream so the StateMachine can detect/repair the missing bytes
+    # (reference passes a null stream for exactly this).
+    is_datastream: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +84,8 @@ class LogEntry:
                        "d": self.smlog.log_data}
             if include_sm_data and self.smlog.sm_data is not None:
                 s["sd"] = self.smlog.sm_data
+            if self.smlog.is_datastream:
+                s["ds"] = True
             d["s"] = s
         if self.conf is not None:
             d["cf"] = {
@@ -98,7 +105,8 @@ class LogEntry:
             s = d["s"]
             smlog = StateMachineLogEntry(
                 client_id=s.get("c", b""), call_id=s.get("id", 0),
-                log_data=s.get("d", b""), sm_data=s.get("sd"))
+                log_data=s.get("d", b""), sm_data=s.get("sd"),
+                is_datastream=s.get("ds", False))
         conf = None
         if "cf" in d:
             c = d["cf"]
@@ -126,10 +134,12 @@ class LogEntry:
 
 def make_transaction_entry(term: int, index: int, client_id: ClientId | bytes,
                            call_id: int, data: bytes,
-                           sm_data: Optional[bytes] = None) -> LogEntry:
+                           sm_data: Optional[bytes] = None,
+                           is_datastream: bool = False) -> LogEntry:
     cid = client_id.to_bytes() if isinstance(client_id, ClientId) else bytes(client_id)
     return LogEntry(term, index, LogEntryKind.STATE_MACHINE,
-                    smlog=StateMachineLogEntry(cid, call_id, data, sm_data))
+                    smlog=StateMachineLogEntry(cid, call_id, data, sm_data,
+                                               is_datastream))
 
 
 def make_config_entry(term: int, index: int, peers, old_peers=(),

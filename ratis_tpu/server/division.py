@@ -973,7 +973,9 @@ class Division:
         entry = make_transaction_entry(self.state.current_term, index,
                                        req.client_id, req.call_id,
                                        trx.log_data or b"",
-                                       sm_data=trx.sm_data)
+                                       sm_data=trx.sm_data,
+                                       is_datastream=(req.type.type
+                                                      == RequestType.DATA_STREAM))
         trx.log_entry = entry
         self.server.transactions[(self.group_id, index)] = trx
         try:
@@ -1313,12 +1315,20 @@ class Division:
                 trx = TransactionContext(log_entry=entry)
             # DataStream link (StateMachine.DataApi.link, §3.5): tie the
             # bytes this peer streamed to the committed entry before apply.
-            if entry.smlog is not None and self.server.datastream is not None:
-                link = self.server.datastream.take_link(
-                    entry.smlog.client_id, entry.smlog.call_id)
-                if link is not None:
+            # A replica that holds no local stream for a DATA_STREAM entry
+            # (crashed between stream CLOSE and apply, or outside the routing
+            # table) still gets data_link(None, entry) so the StateMachine can
+            # detect the miss and fetch/repair — the reference passes a null
+            # stream for exactly this case.
+            if entry.smlog is not None:
+                link = None
+                if self.server.datastream is not None:
+                    link = self.server.datastream.take_link(
+                        entry.smlog.client_id, entry.smlog.call_id)
+                if link is not None or entry.smlog.is_datastream:
                     try:
-                        await sm.data_link(link.local, entry)
+                        await sm.data_link(
+                            link.local if link is not None else None, entry)
                     except Exception:
                         LOG.exception("%s data_link failed", self.member_id)
             try:

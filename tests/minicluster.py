@@ -42,11 +42,22 @@ def fast_properties() -> RaftProperties:
     return p
 
 
+_handed_out_ports: set[int] = set()
+
+
 def free_port() -> int:
+    """Allocate a port the kernel considers free, never handing the same port
+    out twice in this process — bind-then-close lets the kernel recycle a
+    just-closed port for the next bind(0), which raced when a cluster
+    allocated RPC + datastream ports for many peers."""
     import socket
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        if port not in _handed_out_ports:
+            _handed_out_ports.add(port)
+            return port
 
 
 class MiniCluster:

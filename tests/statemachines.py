@@ -6,7 +6,7 @@ round-trips — the teaching SM the per-transport suites drive."""
 from __future__ import annotations
 
 import asyncio
-import pickle
+import msgpack
 from typing import List, Optional
 
 from ratis_tpu.protocol.message import Message
@@ -72,7 +72,7 @@ class RecordingStateMachine(BaseStateMachine):
         if ti.index < 0 or self._storage.directory is None:
             return -1
         path = self._storage.snapshot_path(ti.term, ti.index)
-        path.write_bytes(pickle.dumps(self.applied))
+        path.write_bytes(msgpack.packb(list(self.applied), use_bin_type=True))
         return ti.index
 
     async def restore_from_snapshot(self,
@@ -80,6 +80,6 @@ class RecordingStateMachine(BaseStateMachine):
         if snapshot is None or not snapshot.files:
             return
         import pathlib
-        self.applied = pickle.loads(
-            pathlib.Path(snapshot.files[0].path).read_bytes())
+        self.applied = msgpack.unpackb(
+            pathlib.Path(snapshot.files[0].path).read_bytes(), raw=False)
         self.set_last_applied_term_index(snapshot.term_index)

@@ -204,3 +204,46 @@ def test_filestore_rejects_unsafe_paths():
                 assert not reply.success
 
     run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
+
+
+class LinkRecordingFileStore(FileStoreStateMachine):
+    """Records data_link(None, ...) calls — the missing-stream repair hook."""
+
+    def __init__(self):
+        super().__init__()
+        self.null_link_indices: list[int] = []
+
+    async def data_link(self, stream, entry):
+        if stream is None:
+            self.null_link_indices.append(entry.index)
+        await super().data_link(stream, entry)
+
+
+def test_peer_outside_routing_table_gets_null_link():
+    """A replica that never received the stream still gets
+    data_link(None, entry) at apply so it can detect/repair the miss
+    (reference DataStreamManagement passes a null stream)."""
+
+    async def _test(cluster):
+        leader = await cluster.wait_for_leader()
+        others = [d.member_id.peer_id for d in cluster.divisions()
+                  if d.member_id.peer_id != leader.member_id.peer_id]
+        # route only leader -> others[0]; others[1] is outside the table
+        rt = RoutingTable.chain([leader.member_id.peer_id, others[0]])
+        leader_peer = cluster.group.get_peer(leader.member_id.peer_id)
+        async with cluster.new_client() as client:
+            out = await client.data_stream().stream(
+                _stream_cmd("partial.bin"), routing_table=rt,
+                primary=leader_peer)
+            await out.write_async(b"x" * 4096)
+            reply = await out.close_async()
+            assert reply.success, reply.exception
+            await cluster.wait_applied(reply.log_index)
+        for div in cluster.divisions():
+            sm = div.state_machine
+            if div.member_id.peer_id == others[1]:
+                assert reply.log_index in sm.null_link_indices
+            else:
+                assert reply.log_index not in sm.null_link_indices
+
+    run_with_new_cluster(3, _test, sm_factory=LinkRecordingFileStore)
