@@ -74,8 +74,15 @@ class QuorumEngine:
         self._wake = asyncio.Event()
         self._running = False
         self._jit_cache: dict = {}
+        # Device-resident copy of the batch state (ops.quorum.DeviceState);
+        # None until the first batched tick, invalidated on rebase/regrow.
+        self._dev = None
+        # Next time the scalar path sweeps leaders for staleness; the batched
+        # kernel checks every tick for free, the scalar path throttles the
+        # O(leaders) python sweep to timeout/4.
+        self._next_staleness_ms = 0
         self.metrics = {"ticks": 0, "acks": 0, "commit_advances": 0,
-                        "batched_dispatches": 0}
+                        "batched_dispatches": 0, "refresh_rows": 0}
 
     # -- registration --------------------------------------------------------
 
@@ -144,6 +151,8 @@ class QuorumEngine:
         s.election_deadline_ms[mask] -= np.int32(delta)
         self._ack_ring = [(g, p, m, max(0, t - delta))
                           for g, p, m, t in self._ack_ring]
+        self._next_staleness_ms = 0
+        self._dev = None  # wholesale time shift: re-upload the device state
         return now - delta
 
     async def tick(self) -> None:
@@ -157,14 +166,31 @@ class QuorumEngine:
 
         active = s.active
         if not active:
+            s.dirty.clear()
+            self._dev = None
             return
+
+        # Scatter-max the ack events into the host mirror (O(events)); the
+        # batched path applies the same events on device, keeping mirror and
+        # device in agreement without ever downloading the [G, P] arrays.
+        touched: set[int] = set(s.dirty)
+        for slot, peer, match, t in acks:
+            if s.match_index[slot, peer] < match:
+                s.match_index[slot, peer] = match
+            if s.last_ack_ms[slot, peer] < t:
+                s.last_ack_ms[slot, peer] = t
+            touched.add(slot)
 
         use_batched = (self.use_device
                        or len(active) >= self.scalar_fallback_threshold)
         if use_batched:
             changed = self._tick_batched(acks, now)
         else:
-            changed = self._tick_scalar(acks, now)
+            # host-only mutations make any retained device copy stale; drop
+            # it so a later crossing back over the threshold re-uploads
+            s.dirty.clear()
+            self._dev = None
+            changed = self._tick_scalar(touched, now)
 
         # dispatch callbacks outside the math pass
         for slot, kind, value in changed:
@@ -181,29 +207,31 @@ class QuorumEngine:
 
     # -- scalar path ---------------------------------------------------------
 
-    def _tick_scalar(self, acks, now: int) -> list[tuple[int, str, int]]:
+    def _tick_scalar(self, touched: set[int], now: int
+                     ) -> list[tuple[int, str, int]]:
+        """Python fallback for small group counts: commit math only for
+        slots with new acks / flush advances (``touched``); the O(leaders)
+        staleness sweep runs at most every leadership_timeout/4."""
         s = self.state
         changed: list[tuple[int, str, int]] = []
-        touched: set[int] = set()
-        for slot, peer, match, t in acks:
-            if s.match_index[slot, peer] < match:
-                s.match_index[slot, peer] = match
-            if s.last_ack_ms[slot, peer] < t:
-                s.last_ack_ms[slot, peer] = t
-            touched.add(slot)
+        check_stale = now >= self._next_staleness_ms
+        if check_stale:
+            self._next_staleness_ms = now + max(
+                1, self.leadership_timeout_ms // 4)
 
         for slot in list(s.active):
             role = s.role[slot]
-            if role == ROLE_LEADER and (slot in touched or True):
-                new_commit, did = ref.update_commit(
-                    s.match_index[slot].tolist(), int(s.self_slot[slot]),
-                    int(s.flush_index[slot]), s.conf_cur[slot].tolist(),
-                    s.conf_old[slot].tolist(), int(s.commit_index[slot]),
-                    int(s.first_leader_index[slot]), True)
-                if did:
-                    s.commit_index[slot] = new_commit
-                    changed.append((slot, "commit", new_commit))
-                if ref.check_leadership(
+            if role == ROLE_LEADER:
+                if slot in touched:
+                    new_commit, did = ref.update_commit(
+                        s.match_index[slot].tolist(), int(s.self_slot[slot]),
+                        int(s.flush_index[slot]), s.conf_cur[slot].tolist(),
+                        s.conf_old[slot].tolist(), int(s.commit_index[slot]),
+                        int(s.first_leader_index[slot]), True)
+                    if did:
+                        s.commit_index[slot] = new_commit
+                        changed.append((slot, "commit", new_commit))
+                if check_stale and ref.check_leadership(
                         s.last_ack_ms[slot].tolist(), int(s.self_slot[slot]),
                         s.conf_cur[slot].tolist(), s.conf_old[slot].tolist(),
                         now, self.leadership_timeout_ms, True):
@@ -219,44 +247,82 @@ class QuorumEngine:
         if "step" not in self._jit_cache:
             import jax
             from ratis_tpu.ops import quorum as q
-            self._jit_cache["step"] = jax.jit(q.engine_step)
+            # Donating the DeviceState keeps the [G, P] batch resident on
+            # device: each tick consumes the old buffers and returns new ones
+            # without a host round-trip.
+            self._jit_cache["step"] = jax.jit(q.engine_step_resident,
+                                              donate_argnums=(0,))
         return self._jit_cache["step"]
+
+    def _upload_device_state(self):
+        import jax.numpy as jnp
+        from ratis_tpu.ops import quorum as q
+        s = self.state
+        return q.DeviceState(
+            jnp.asarray(s.match_index), jnp.asarray(s.last_ack_ms),
+            jnp.asarray(s.self_mask), jnp.asarray(s.conf_cur),
+            jnp.asarray(s.conf_old), jnp.asarray(s.role),
+            jnp.asarray(s.flush_index), jnp.asarray(s.commit_index),
+            jnp.asarray(s.first_leader_index),
+            jnp.asarray(s.election_deadline_ms))
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        return 1 << (max(1, n) - 1).bit_length()
 
     def _tick_batched(self, acks, now: int) -> list[tuple[int, str, int]]:
         import jax.numpy as jnp
 
         s = self.state
         self.metrics["batched_dispatches"] += 1
-        # pad event arrays to a power-of-two length (shape-stable jit)
-        n = max(1, len(acks))
-        cap = 1 << (n - 1).bit_length()
-        evg = np.zeros(cap, np.int32)
-        evp = np.zeros(cap, np.int32)
-        evm = np.zeros(cap, np.int32)
-        evt = np.zeros(cap, np.int32)
-        evv = np.zeros(cap, bool)
+
+        if self._dev is None or self._dev.match_index.shape != s.match_index.shape:
+            # first batched tick / capacity regrow / epoch rebase: one full
+            # upload, after which only dirty rows and events travel.
+            self._dev = self._upload_device_state()
+            s.dirty.clear()
+
+        # dirty-row refresh: O(changed slots) host->device
+        dirty = sorted(s.dirty)
+        s.dirty.clear()
+        self.metrics["refresh_rows"] += len(dirty)
+        dcap = self._pow2(len(dirty))
+        # padded entries point one past the end -> dropped by the scatter
+        rf_idx = np.full(dcap, s.capacity, np.int32)
+        rf_idx[:len(dirty)] = dirty
+        gi = np.minimum(rf_idx, s.capacity - 1)  # in-range gather indices
+
+        # packed ack events: O(events) host->device
+        ecap = self._pow2(len(acks))
+        evg = np.zeros(ecap, np.int32)
+        evp = np.zeros(ecap, np.int32)
+        evm = np.zeros(ecap, np.int32)
+        evt = np.zeros(ecap, np.int32)
+        evv = np.zeros(ecap, bool)
         for i, (slot, peer, match, t) in enumerate(acks):
             evg[i], evp[i], evm[i], evt[i], evv[i] = slot, peer, match, t, True
 
         step = self._kernels()
-        match, last_ack, new_commit, commit_changed, timeouts, stale = step(
-            jnp.asarray(s.match_index), jnp.asarray(s.last_ack_ms),
+        res = step(
+            self._dev,
+            jnp.asarray(rf_idx), jnp.asarray(s.match_index[gi]),
+            jnp.asarray(s.last_ack_ms[gi]), jnp.asarray(s.self_mask[gi]),
+            jnp.asarray(s.conf_cur[gi]), jnp.asarray(s.conf_old[gi]),
+            jnp.asarray(s.role[gi]), jnp.asarray(s.flush_index[gi]),
+            jnp.asarray(s.commit_index[gi]),
+            jnp.asarray(s.first_leader_index[gi]),
+            jnp.asarray(s.election_deadline_ms[gi]),
             jnp.asarray(evg), jnp.asarray(evp), jnp.asarray(evm),
-            jnp.asarray(evt), jnp.asarray(evv), jnp.asarray(s.self_mask),
-            jnp.asarray(s.flush_index), jnp.asarray(s.conf_cur),
-            jnp.asarray(s.conf_old), jnp.asarray(s.commit_index),
-            jnp.asarray(s.first_leader_index), jnp.asarray(s.role),
-            jnp.asarray(s.election_deadline_ms), jnp.int32(now),
-            jnp.int32(self.leadership_timeout_ms))
+            jnp.asarray(evt), jnp.asarray(evv),
+            jnp.int32(now), jnp.int32(self.leadership_timeout_ms))
+        self._dev = res.state
 
-        # np.asarray over a jax array is a read-only view; divisions mutate
-        # these between ticks, so copy back into writable buffers.
-        np.copyto(s.match_index, np.asarray(match))
-        np.copyto(s.last_ack_ms, np.asarray(last_ack))
-        new_commit_np = np.asarray(new_commit)
-        commit_changed_np = np.asarray(commit_changed)
-        timeouts_np = np.asarray(timeouts)
-        stale_np = np.asarray(stale)
+        # downloads: only the [G] outputs (masks + commit values), never the
+        # [G, P] state
+        new_commit_np = np.asarray(res.new_commit)
+        commit_changed_np = np.asarray(res.commit_changed)
+        timeouts_np = np.asarray(res.timeouts)
+        stale_np = np.asarray(res.stale)
 
         changed: list[tuple[int, str, int]] = []
         for slot in np.nonzero(commit_changed_np)[0]:
@@ -266,6 +332,8 @@ class QuorumEngine:
                 changed.append((i, "commit", int(new_commit_np[i])))
         for slot in np.nonzero(timeouts_np)[0]:
             i = int(slot)
+            # the kernel disarmed the deadline on device; mirror that here
+            # (direct write, NOT mark_dirty: host and device already agree)
             if i in s.active:
                 s.election_deadline_ms[i] = NO_DEADLINE
                 changed.append((i, "timeout", 0))

@@ -45,12 +45,21 @@ class GroupBatchState:
         self.election_deadline_ms = np.full(g, NO_DEADLINE, np.int32)
         self._free: list[int] = list(range(g - 1, -1, -1))
         self.active: set[int] = set()
+        # Slots whose host-side state changed since the last engine tick.
+        # The device-resident tick uploads ONLY these rows (plus packed ack
+        # events); the scalar tick re-runs commit math only for these.
+        self.dirty: set[int] = set()
+
+    def mark_dirty(self, slot: int) -> None:
+        if slot >= 0:
+            self.dirty.add(slot)
 
     def allocate(self) -> int:
         if not self._free:
             self._grow()
         slot = self._free.pop()
         self.active.add(slot)
+        self.mark_dirty(slot)
         return slot
 
     def release(self, slot: int) -> None:
@@ -64,6 +73,7 @@ class GroupBatchState:
         self.commit_index[slot] = -1
         self.election_deadline_ms[slot] = NO_DEADLINE
         self._free.append(slot)
+        self.mark_dirty(slot)
 
     def _grow(self) -> None:
         """Double capacity (pad arrays); jit caches per shape, and doubling
@@ -103,3 +113,4 @@ class GroupBatchState:
         self.conf_old[slot] = old_mask
         self.priority[slot] = priorities
         self.self_priority[slot] = self_priority
+        self.mark_dirty(slot)

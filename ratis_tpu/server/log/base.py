@@ -30,6 +30,20 @@ class RaftLog:
         self._commit_index = INVALID_LOG_INDEX
         self._purge_index = INVALID_LOG_INDEX
         self._open = False
+        # Flush observers (set by the division): invoked when flush_index
+        # advances asynchronously / when a write fails.  Durable logs call
+        # these from the worker's completion path; the in-memory log flushes
+        # synchronously inside append so it never needs them.
+        self._flush_cb = None
+        self._flush_err_cb = None
+
+    def set_flush_callbacks(self, on_flush, on_error) -> None:
+        """on_flush(flush_index) fires after flush_index advances without the
+        appender having awaited it (the decoupled leader path,
+        reference SegmentedRaftLogWorker.java:302,368); on_error(exc) fires
+        when the backing write fails (StateMachine.notifyLogFailed)."""
+        self._flush_cb = on_flush
+        self._flush_err_cb = on_error
 
     # -- open/close ----------------------------------------------------------
 
@@ -108,8 +122,17 @@ class RaftLog:
 
     # -- append --------------------------------------------------------------
 
-    async def append_entry(self, entry: LogEntry) -> int:
-        """Append one entry (leader path); resolves when durable."""
+    async def append_entry(self, entry: LogEntry, wait_flush: bool = True) -> int:
+        """Append one entry.  With ``wait_flush`` (follower path / default)
+        the coroutine resolves only once the entry is durable — a follower's
+        append reply must mean "on disk" (matchIndex == durable).  With
+        ``wait_flush=False`` (leader hot path) it returns after the in-memory
+        append: the write is queued, flush_index advances when the shared
+        worker fsyncs, and the registered flush callback wakes the engine —
+        the leader's commit math consumes flush_index, so correctness is
+        preserved while the fsync overlaps follower RPCs (reference decouples
+        identically: SegmentedRaftLog.appendEntryImpl:392 queues, flushIndex
+        advances asynchronously)."""
         raise NotImplementedError
 
     async def append_entries_follower(self, entries: Sequence[LogEntry]) -> int:

@@ -54,7 +54,7 @@ class MemoryRaftLog(RaftLog):
             return self._below_start
         return None
 
-    async def append_entry(self, entry: LogEntry) -> int:
+    async def append_entry(self, entry: LogEntry, wait_flush: bool = True) -> int:
         expected = self.next_index
         if entry.index != expected:
             raise ValueError(f"{self.name}: appending index {entry.index}, "

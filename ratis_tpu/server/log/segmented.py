@@ -31,7 +31,8 @@ import time
 import zlib
 from typing import Optional
 
-from ratis_tpu.protocol.exceptions import ChecksumException
+from ratis_tpu.protocol.exceptions import (ChecksumException,
+                                           RaftLogIOException)
 from ratis_tpu.protocol.logentry import LogEntry
 from ratis_tpu.protocol.termindex import INVALID_LOG_INDEX, TermIndex
 from ratis_tpu.server.log.base import RaftLog
@@ -218,6 +219,11 @@ class SegmentedRaftLog(RaftLog):
         self._open_file = None
         self._flush_index = INVALID_LOG_INDEX
         self._below_start: Optional[TermIndex] = None
+        # Latched on the first failed write: flush_index must never advance
+        # past a hole (a later successful fsync does NOT make earlier failed
+        # bytes durable), and further appends are refused — the reference's
+        # log worker terminates on IO failure the same way.
+        self._failed: Optional[Exception] = None
         from ratis_tpu.metrics import SegmentedRaftLogMetrics
         self.metrics = SegmentedRaftLogMetrics(name)
 
@@ -342,11 +348,15 @@ class SegmentedRaftLog(RaftLog):
         self._open_file = None
         self._close_segment_file(seg)
 
-    async def append_entry(self, entry: LogEntry) -> int:
+    async def append_entry(self, entry: LogEntry, wait_flush: bool = True) -> int:
         with self.metrics.append_timer.time():
-            return await self._append_entry_impl(entry)
+            return await self._append_entry_impl(entry, wait_flush)
 
-    async def _append_entry_impl(self, entry: LogEntry) -> int:
+    async def _append_entry_impl(self, entry: LogEntry,
+                                 wait_flush: bool) -> int:
+        if self._failed is not None:
+            raise RaftLogIOException(
+                f"{self.name}: log failed permanently") from self._failed
         expected = self.next_index
         if entry.index != expected:
             raise ValueError(f"{self.name}: appending index {entry.index}, "
@@ -362,10 +372,31 @@ class SegmentedRaftLog(RaftLog):
         seg.offsets.append(seg.size)
         seg.size += len(record)
         fut = self.worker.submit(self._open_file, record)
-        await fut
-        if entry.index > self._flush_index:
-            self._flush_index = entry.index
-        return entry.index
+        index = entry.index
+
+        # flush_index advances from the worker's completion, in submit order
+        # (the worker resolves a batch's futures in order, and done-callbacks
+        # run before any awaiter resumes), so it stays contiguous whether or
+        # not the caller awaits (SegmentedRaftLogWorker flushIfNecessary:368).
+        def _on_flush(f: "asyncio.Future") -> None:
+            if f.cancelled():
+                return
+            exc = f.exception()
+            if exc is not None:
+                first = self._failed is None
+                self._failed = self._failed or exc
+                if first and self._flush_err_cb is not None:
+                    self._flush_err_cb(exc)
+                return
+            if self._failed is None and index > self._flush_index:
+                self._flush_index = index
+                if self._flush_cb is not None:
+                    self._flush_cb(self._flush_index)
+
+        fut.add_done_callback(_on_flush)
+        if wait_flush:
+            await fut
+        return index
 
     # ------------------------------------------------------------ truncate
 

@@ -39,6 +39,20 @@ def fast_properties() -> RaftProperties:
     RaftServerConfigKeys.Rpc.set_timeout(p, "100ms", "200ms")
     p.set("raft.tpu.engine.tick-interval", "5ms")
     RaftServerConfigKeys.Log.set_use_memory(p, True)
+    import os
+    if os.environ.get("RATIS_TPU_TEST_BATCHED"):
+        # CI knob: force EVERY cluster suite through the jitted batched
+        # engine path (scalar fallback disabled).
+        p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
+    return p
+
+
+def batched_properties() -> RaftProperties:
+    """fast_properties but every engine tick runs the jitted batched kernel
+    (scalar_fallback_threshold=0): the TPU-native execution mode under the
+    same cluster scenarios."""
+    p = fast_properties()
+    p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
     return p
 
 
@@ -202,9 +216,10 @@ class MiniCluster:
 
     def _request(self, server_id: RaftPeerId, message: bytes,
                  type_case: TypeCase,
-                 call_id: Optional[int] = None) -> RaftClientRequest:
+                 call_id: Optional[int] = None,
+                 group_id: Optional[RaftGroupId] = None) -> RaftClientRequest:
         return RaftClientRequest(self.client_id, server_id,
-                                 self.group.group_id,
+                                 group_id or self.group.group_id,
                                  call_id if call_id is not None
                                  else next(self._call_ids),
                                  Message.value_of(message), type=type_case)
@@ -212,7 +227,8 @@ class MiniCluster:
     async def send(self, message: bytes, type_case: Optional[TypeCase] = None,
                    server_id: Optional[RaftPeerId] = None,
                    timeout: float = DEFAULT_TIMEOUT,
-                   call_id: Optional[int] = None) -> RaftClientReply:
+                   call_id: Optional[int] = None,
+                   group_id: Optional[RaftGroupId] = None) -> RaftClientReply:
         """Minimal failover client: follow NotLeaderException hints, retry on
         not-ready (the full RaftClient lands with the client milestone)."""
         type_case = type_case or write_request_type()
@@ -225,7 +241,7 @@ class MiniCluster:
             if server is None:
                 target = next(iter(self.servers))
                 continue
-            req = self._request(target, message, type_case, call_id)
+            req = self._request(target, message, type_case, call_id, group_id)
             try:
                 reply = await client.send_request(server.address, req)
             except (RaftException, TimeoutError) as e:

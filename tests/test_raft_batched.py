@@ -1,0 +1,174 @@
+"""Cluster suites with the batched engine ENGAGED on every tick
+(scalar_fallback_threshold=0): the TPU-native execution mode running the
+same scenarios the scalar-fallback suites cover, plus the multi-raft axis
+itself — many groups on one server trio with concurrent writes, elections,
+and kill/restart (reference RaftServerProxy.java:89-188 multi-group hosting,
+MiniRaftCluster.runWithNewCluster harness).
+"""
+
+import asyncio
+
+import pytest
+
+from minicluster import (MiniCluster, batched_properties,
+                         run_with_new_cluster)
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.peer import RaftPeer
+
+
+def run_batched(num_servers, test, **kwargs):
+    kwargs.setdefault("properties", batched_properties())
+    run_with_new_cluster(num_servers, test, **kwargs)
+
+
+def test_batched_write_replicate_apply():
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        for i in range(1, 8):
+            reply = await cluster.send_write()
+            assert reply.success
+            assert reply.message.content == str(i).encode()
+        # every tick went through the jitted kernel
+        engines = [s.engine for s in cluster.servers.values()]
+        assert all(e.metrics["batched_dispatches"] > 0 for e in engines)
+        assert all(e.metrics["ticks"] == e.metrics["batched_dispatches"]
+                   for e in engines)
+        last = cluster.leaders()[0].state.log.get_last_committed_index()
+        await cluster.wait_applied(last)
+        for d in cluster.divisions():
+            assert d.state_machine.counter == 7
+
+    run_batched(3, body)
+
+
+def test_batched_leader_kill_reelection():
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        assert (await cluster.send_write()).success
+        await cluster.kill_server(leader.member_id.peer_id)
+        new_leader = await cluster.wait_for_leader()
+        assert new_leader.member_id != leader.member_id
+        reply = await cluster.send_write()
+        assert reply.success
+        assert reply.message.content == b"2"
+
+    run_batched(3, body)
+
+
+def test_batched_reconfiguration_add_peers():
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for _ in range(3):
+                assert (await client.io().send(b"INCREMENT")).success
+            new_peers = [RaftPeer(RaftPeerId.value_of(f"y{i}"),
+                                  address=f"sim:y{i}") for i in range(2)]
+            for p in new_peers:
+                await cluster.add_new_server(p)
+            current = list(cluster.group.peers)
+            reply = await client.admin().set_configuration(
+                current + new_peers)
+            assert reply.success, reply.exception
+            assert (await client.io().send(b"INCREMENT")).success
+            # all 5 members converge
+            await asyncio.sleep(0)
+            for s in cluster.servers.values():
+                d = s.divisions.get(cluster.group.group_id)
+                if d is not None:
+                    assert len(d.state.configuration.conf.peers) == 5
+
+    run_batched(3, body)
+
+
+def _make_sibling_group(base: RaftGroup) -> RaftGroup:
+    return RaftGroup.value_of(RaftGroupId.random_id(), base.peers)
+
+
+async def _wait_group_leader(cluster: MiniCluster, group_id,
+                             timeout: float = 20.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        leaders = [s.divisions[group_id] for s in cluster.servers.values()
+                   if group_id in s.divisions
+                   and s.divisions[group_id].is_leader()]
+        if leaders:
+            top = max(leaders, key=lambda d: d.state.current_term)
+            if all(d.state.current_term < top.state.current_term
+                   for d in leaders if d is not top):
+                return top
+        await asyncio.sleep(0.02)
+    raise TimeoutError(f"no leader for group {group_id} after {timeout}s")
+
+
+def test_64_groups_concurrent_writes_and_restart():
+    """The multi-raft axis in anger: 64 groups on one 3-server trio, all
+    ticked by ONE engine per server through the batched kernel; concurrent
+    writes across every group, then a server kill + writes + restart +
+    catch-up (reference RaftServerProxy multi-group + ServerRestartTests)."""
+
+    N_GROUPS = 64
+    WRITES_PER_GROUP = 3
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        groups = [cluster.group]
+        for _ in range(N_GROUPS - 1):
+            g = _make_sibling_group(cluster.group)
+            for s in cluster.servers.values():
+                await s.group_add(g)
+            groups.append(g)
+
+        # engine hosts all 64 slots per server
+        for s in cluster.servers.values():
+            assert len(s.engine.state.active) == N_GROUPS
+
+        await asyncio.gather(*(
+            _wait_group_leader(cluster, g.group_id) for g in groups))
+
+        async def write_group(g: RaftGroup, n: int):
+            for _ in range(n):
+                reply = await cluster.send(b"INCREMENT",
+                                           group_id=g.group_id,
+                                           timeout=30.0)
+                assert reply.success
+        await asyncio.gather(*(
+            write_group(g, WRITES_PER_GROUP) for g in groups))
+
+        engines = [s.engine for s in cluster.servers.values()]
+        assert all(e.metrics["batched_dispatches"] > 0 for e in engines)
+
+        # kill one server: every group keeps a 2/3 majority
+        victim = next(iter(cluster.servers))
+        await cluster.kill_server(victim)
+        await asyncio.gather(*(
+            write_group(g, 1) for g in groups[:8]))
+
+        # restart: the victim re-hosts ALL groups from scratch (memory logs
+        # are volatile, so it rejoins via normal append catch-up)
+        server = await cluster.restart_server(victim)
+        for g in groups[1:]:
+            await server.group_add(g)
+
+        async def caught_up():
+            for g in groups[:8]:
+                d = server.divisions.get(g.group_id)
+                lead = await _wait_group_leader(cluster, g.group_id)
+                if d is None or \
+                        d.applied_index < lead.state.log.get_last_committed_index():
+                    return False
+            return True
+        deadline = asyncio.get_event_loop().time() + 20.0
+        while asyncio.get_event_loop().time() < deadline:
+            if await caught_up():
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("restarted server did not catch up")
+
+        # spot-check convergence on a written group
+        g = groups[3]
+        lead = await _wait_group_leader(cluster, g.group_id)
+        assert lead.state_machine.counter >= WRITES_PER_GROUP
+
+    run_batched(3, body)

@@ -259,3 +259,67 @@ def test_log_factory_with_durable_storage_rejected(tmp_path):
         await cluster.close()
 
     run(body())
+
+
+class TestDecoupledFlush:
+    def test_leader_append_returns_before_flush(self, tmp_path):
+        """wait_flush=False returns after the in-memory append; flush_index
+        catches up from the worker and fires the flush callback."""
+
+        async def body():
+            log = SegmentedRaftLog("t", tmp_path, worker=LogWorker("wd1"))
+            flushed = []
+            log.set_flush_callbacks(flushed.append, lambda e: None)
+            await log.open()
+            for i in range(5):
+                await log.append_entry(entry(1, i), wait_flush=False)
+            assert log.next_index == 5  # appended in memory
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while log.flush_index < 4:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            assert flushed[-1] == 4
+            await log.close()
+
+        run(body())
+
+    def test_failed_write_latches_log_dead(self, tmp_path, monkeypatch):
+        """A failed fsync must latch the log: flush_index never advances past
+        the hole even when LATER batches succeed, the error callback fires
+        once, and further appends are refused (reference log worker
+        terminates on IO failure)."""
+        from ratis_tpu.protocol.exceptions import RaftLogIOException
+        from ratis_tpu.server.log import segmented as seg_mod
+
+        async def body():
+            log = SegmentedRaftLog("t", tmp_path, worker=LogWorker("wd2"))
+            errors = []
+            log.set_flush_callbacks(lambda i: None, errors.append)
+            await log.open()
+            await log.append_entry(entry(1, 0))
+            assert log.flush_index == 0
+
+            real_fsync = seg_mod.os.fsync
+            fail = {"on": True}
+
+            def flaky_fsync(fd):
+                if fail["on"]:
+                    raise OSError(28, "No space left on device")
+                real_fsync(fd)
+
+            monkeypatch.setattr(seg_mod.os, "fsync", flaky_fsync)
+            await log.append_entry(entry(1, 1), wait_flush=False)
+            # let the failing batch complete
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while not errors:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            fail["on"] = False  # disk "recovers" — must make no difference
+            with pytest.raises(RaftLogIOException):
+                await log.append_entry(entry(1, 2))
+            assert log.flush_index == 0  # never advanced past the hole
+            assert len(errors) == 1
+            monkeypatch.setattr(seg_mod.os, "fsync", real_fsync)
+            await log.close()
+
+        run(body())
