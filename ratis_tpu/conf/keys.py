@@ -152,6 +152,8 @@ class RaftServerConfigKeys:
             SNAPSHOT_CHUNK_SIZE_MAX_DEFAULT = "16MB"
             INSTALL_SNAPSHOT_ENABLED_KEY = "raft.server.log.appender.install.snapshot.enabled"
             INSTALL_SNAPSHOT_ENABLED_DEFAULT = True
+            PIPELINE_WINDOW_KEY = "raft.server.log.appender.pipeline.window"
+            PIPELINE_WINDOW_DEFAULT = 16  # in-flight AppendEntries per follower
             WAIT_TIME_MIN_KEY = "raft.server.log.appender.wait-time.min"
             WAIT_TIME_MIN_DEFAULT = TimeDuration.millis(10)
 
@@ -166,6 +168,12 @@ class RaftServerConfigKeys:
                 return p.get_boolean(
                     RaftServerConfigKeys.Log.Appender.INSTALL_SNAPSHOT_ENABLED_KEY,
                     RaftServerConfigKeys.Log.Appender.INSTALL_SNAPSHOT_ENABLED_DEFAULT)
+
+            @staticmethod
+            def pipeline_window(p: RaftProperties) -> int:
+                return p.get_int(
+                    RaftServerConfigKeys.Log.Appender.PIPELINE_WINDOW_KEY,
+                    RaftServerConfigKeys.Log.Appender.PIPELINE_WINDOW_DEFAULT)
 
     class Snapshot:
         AUTO_TRIGGER_ENABLED_KEY = "raft.server.snapshot.auto.trigger.enabled"

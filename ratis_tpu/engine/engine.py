@@ -101,6 +101,17 @@ class QuorumEngine:
         self._ack_ring.append((slot, peer_slot, match_index, self.clock.now_ms()))
         self._wake.set()
 
+    def regress_match(self, slot: int, peer_slot: int, match_index: int) -> None:
+        """A follower provably lost acked entries (volatile-log restart):
+        lower the mirror AND clamp any acks for this (group, peer) still
+        queued in the ring — otherwise the next tick's scatter-max replays a
+        pre-restart ack and silently restores the lost match."""
+        self._ack_ring = [
+            (g, p, min(m, match_index) if (g, p) == (slot, peer_slot) else m, t)
+            for g, p, m, t in self._ack_ring]
+        self.state.match_index[slot, peer_slot] = match_index
+        self.state.mark_dirty(slot)
+
     def notify(self) -> None:
         """Wake the tick loop early (e.g. flush index advanced)."""
         self._wake.set()

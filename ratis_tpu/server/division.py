@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from typing import Optional
 
 import numpy as np
@@ -141,6 +142,17 @@ class Division:
         self._running = False
         self._rng = random.Random(hash((str(self.member_id),)) & 0xFFFFFFFF)
         self._last_heard_leader_s = 0.0
+        # Pipelined leaders keep several AppendEntries in flight; transports
+        # deliver per-link FIFO, and this lock keeps *processing* in arrival
+        # order too (the reference gets this from its serial gRPC stream,
+        # GrpcServerProtocolService appendEntries stream observer).
+        self._append_lock = asyncio.Lock()
+        self._slowness_timeout_s = \
+            RaftServerConfigKeys.Rpc.slowness_timeout(p).seconds
+        self._slowness_notified: dict[RaftPeerId, float] = {}
+        # Fire-and-forget notification tasks: the loop holds only weak refs,
+        # so keep strong ones until completion or GC may drop them unrun.
+        self._bg_tasks: set[asyncio.Task] = set()
 
         # admin state
         self.pending_reconf = None  # Optional[admin.PendingReconf]
@@ -347,11 +359,16 @@ class Division:
     def _on_log_flush(self, flush_index: int) -> None:
         self._engine_update_flush()
 
+    def _spawn_bg(self, coro) -> None:
+        t = asyncio.ensure_future(coro)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+
     def _on_log_failed(self, exc: Exception) -> None:
         if not self._running:
             return
         LOG.error("%s log write failed: %s", self.member_id, exc)
-        asyncio.ensure_future(self._handle_log_failure(exc))
+        self._spawn_bg(self._handle_log_failure(exc))
 
     async def _handle_log_failure(self, exc: Exception) -> None:
         """A broken log cannot back leadership: notify the SM and step down
@@ -371,6 +388,9 @@ class Division:
             self.election.stop()
         if self._election_task is not None:
             self._election_task.cancel()
+        for t in list(self._bg_tasks):
+            t.cancel()
+        self._bg_tasks.clear()
         if self.leader_ctx is not None:
             await self.leader_ctx.stop()
             self.leader_ctx = None
@@ -397,8 +417,11 @@ class Division:
         if not self._running or not self.is_follower():
             return
         if self._election_paused \
+                or self.state.log.failed \
                 or not self.state.configuration.contains_voting(
                     self.member_id.peer_id):
+            # A dead log cannot back leadership (the reference terminates the
+            # server on log failure): never campaign with one.
             self.reset_election_deadline()
             return
         self.election_metrics.timeout_count.inc()
@@ -469,7 +492,15 @@ class Division:
         self.leader_ctx.startup_index = index
         st.first_leader_index[self.engine_slot] = index
         st.mark_dirty(self.engine_slot)
-        await self.state.log.append_entry(entry)
+        try:
+            await self.state.log.append_entry(entry)
+        except Exception as e:
+            # Log died between the vote and the startup append: abdicate
+            # immediately instead of lingering as a heartbeat-less leader.
+            LOG.error("%s startup entry append failed: %s", self.member_id, e)
+            await self.change_to_follower(self.state.current_term, None,
+                                          reason=f"startup append failed: {e}")
+            return
         self.state.apply_log_entry_configuration(entry)
         self._engine_update_flush()
         self.leader_ctx.start_appenders()
@@ -561,7 +592,8 @@ class Division:
     async def handle_append_entries(self, req: AppendEntriesRequest
                                     ) -> AppendEntriesReply:
         with self.metrics.follower_append_timer.time():
-            return await self._handle_append_entries_impl(req)
+            async with self._append_lock:
+                return await self._handle_append_entries_impl(req)
 
     async def _handle_append_entries_impl(self, req: AppendEntriesRequest
                                           ) -> AppendEntriesReply:
@@ -785,6 +817,50 @@ class Division:
                                       follower.match_index)
         self._update_watch_frontiers()
 
+    def on_follower_match_regressed(self, follower: FollowerInfo) -> None:
+        """A follower provably lost acked entries (volatile-log restart):
+        write the lowered match through to the engine mirror so quorum math
+        no longer counts the lost entries."""
+        slot = self.peer_slots.get(follower.peer_id)
+        if slot is not None and self.engine_slot >= 0:
+            self.server.engine.regress_match(self.engine_slot, slot,
+                                             follower.match_index)
+
+    def check_follower_slowness(self, follower: FollowerInfo) -> None:
+        """Leader-side slow-follower detection (reference
+        RaftServerImpl.checkSlowness via LogAppenderBase + StateMachine
+        .notifyFollowerSlowness, StateMachine.java:247): if a follower has
+        not responded for Rpc.slowness_timeout, tell the state machine —
+        at most once per timeout period per follower."""
+        if self._slowness_timeout_s <= 0 or follower.snapshot_in_progress:
+            # A follower taking a (possibly long) snapshot install is busy,
+            # not slow; its chunk replies refresh last_rpc_response_s anyway.
+            return
+        now = time.monotonic()
+        elapsed = now - follower.last_rpc_response_s
+        if elapsed < self._slowness_timeout_s:
+            self._slowness_notified.pop(follower.peer_id, None)
+            return
+        last = self._slowness_notified.get(follower.peer_id, 0.0)
+        if now - last < self._slowness_timeout_s:
+            return
+        self._slowness_notified[follower.peer_id] = now
+        peer = self.state.configuration.get_peer(follower.peer_id)
+        self._spawn_bg(self.state_machine.notify_follower_slowness(
+            self.role_info(), peer))
+
+    def role_info(self):
+        """A RoleInfoProto analog handed to StateMachine notifications
+        (reference RoleInfoProto, Raft.proto:537)."""
+        return {
+            "peer_id": str(self.member_id.peer_id),
+            "group_id": str(self.group_id),
+            "role": self.role.name,
+            "term": self.state.current_term,
+            "leader_id": (str(self.state.leader_id)
+                          if self.state.leader_id is not None else None),
+        }
+
     def on_follower_heartbeat_ack(self, follower: FollowerInfo) -> None:
         slot = self.peer_slots.get(follower.peer_id)
         if slot is not None and self.engine_slot >= 0:
@@ -974,7 +1050,20 @@ class Division:
                     raise  # our caller was cancelled, not the entry
 
         with self.metrics.write_timer.time():
-            reply = await self._write_impl(req)
+            try:
+                reply = await self._write_impl(req)
+            except asyncio.CancelledError:
+                cache_entry.fail()
+                raise
+            except Exception as e:
+                # e.g. RaftLogIOException from a latched-dead log: the cache
+                # entry must resolve or every retry of this call_id hangs on
+                # its future forever.
+                cache_entry.fail()
+                self.metrics.num_failed.inc()
+                exc = e if isinstance(e, RaftException) \
+                    else RaftException(str(e))
+                return RaftClientReply.failure_reply(req, exc)
         if not reply.success:
             self.metrics.num_failed.inc()
         if reply.success:

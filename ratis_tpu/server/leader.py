@@ -107,49 +107,70 @@ class FollowerInfo:
             return True
         return False
 
-    def decrease_next_index(self, hint: int) -> None:
-        """INCONSISTENCY backoff (LogAppenderDefault.java:187)."""
-        self.next_index = max(0, min(hint, self.next_index - 1))
-
-
 class LogAppender:
-    """One leader->follower replication driver as an asyncio task
-    (reference GrpcLogAppender pipelining is approximated by issuing the next
-    batch immediately after each ack; heartbeats fire on idle timeout)."""
+    """One leader->follower replication driver with a pipelined send window.
+
+    Mirrors the reference GrpcLogAppender (GrpcLogAppender.java:343-381):
+    up to ``window_limit`` AppendEntries requests are in flight at once —
+    ``follower.next_index`` is the optimistic *send* cursor, advanced when a
+    batch is handed to the transport, while ``follower.match_index`` advances
+    only on acks.  Replies may complete out of order; all transports deliver
+    per-link FIFO (TCP streams; the simulated hub models the same), so the
+    follower observes batches in send order.  A dedicated heartbeat timer
+    (reference's separate heartbeat channel, GrpcLogAppender.java:172) fires
+    outside the window and is never queued behind a full pipeline.  On
+    INCONSISTENCY or an RPC error the window resets: the epoch is bumped so
+    in-flight completions from before the reset are ignored, and the send
+    cursor rewinds (GrpcLogAppender.onError/resetClient:475-530).
+    """
 
     def __init__(self, division, follower: FollowerInfo,
-                 heartbeat_interval_s: float, buffer_byte_limit: int):
+                 heartbeat_interval_s: float, buffer_byte_limit: int,
+                 window_limit: int = 16):
         self.division = division
         self.follower = follower
         self.heartbeat_interval_s = heartbeat_interval_s
         self.buffer_byte_limit = buffer_byte_limit
+        self.window_limit = max(1, window_limit)
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
+        self._hb_task: Optional[asyncio.Task] = None
         self._running = False
+        self._epoch = 0        # bumped on window reset; stale replies ignored
+        self._inflight = 0     # pipelined (non-heartbeat) requests outstanding
+        self._last_send_s = 0.0
+        self._backoff_until = 0.0
+        self._pending_sends: set[asyncio.Task] = set()
 
     def start(self) -> None:
         self._running = True
-        self._task = asyncio.create_task(
-            self._run(), name=f"appender-{self.division.member_id}-{self.follower.peer_id}")
+        name = f"appender-{self.division.member_id}-{self.follower.peer_id}"
+        self._task = asyncio.create_task(self._run(), name=name)
+        self._hb_task = asyncio.create_task(self._heartbeat_loop(),
+                                            name=name + "-hb")
 
     async def stop(self) -> None:
         self._running = False
-        if self._task is not None:
-            self._wake.set()
-            self._task.cancel()
+        self._wake.set()
+        tasks = [t for t in (self._task, self._hb_task) if t is not None]
+        tasks += list(self._pending_sends)
+        self._task = self._hb_task = None
+        self._pending_sends.clear()
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
             try:
-                await self._task
-            except asyncio.CancelledError:
+                await t
+            except (asyncio.CancelledError, Exception):
                 pass
-            self._task = None
 
     def notify(self) -> None:
         self._wake.set()
 
-    def _build_request(self) -> Optional[AppendEntriesRequest]:
+    def _build_request(self, next_idx: int, heartbeat: bool = False
+                       ) -> Optional[AppendEntriesRequest]:
         div = self.division
         log = div.state.log
-        next_idx = self.follower.next_index
         if next_idx < log.start_index:
             return None  # needs snapshot (handled by caller)
         prev: Optional[TermIndex] = None
@@ -163,56 +184,113 @@ class LogAppender:
                 prev = div.snapshot_term_index(next_idx - 1)
                 if prev is None:
                     return None
-        entries = log.get_entries(next_idx, log.next_index,
-                                  self.buffer_byte_limit)
+        if heartbeat:
+            entries = ()
+        else:
+            entries = tuple(log.get_entries(next_idx, log.next_index,
+                                            self.buffer_byte_limit))
         return AppendEntriesRequest(
             header=RaftRpcHeader(div.member_id.peer_id, self.follower.peer_id,
                                  div.group_id),
             leader_term=div.state.current_term,
             previous=prev,
-            entries=tuple(entries),
+            entries=entries,
             leader_commit=log.get_last_committed_index(),
         )
 
-    async def _run(self) -> None:
+    # -------------------------------------------------------------- window
+
+    def _reset_window(self, *, rewind_to: Optional[int] = None,
+                      backoff_s: float = 0.0) -> None:
+        """Discard the pipeline: ignore everything in flight, rewind the send
+        cursor (reference resetClient: follower.decreaseNextIndex + clear the
+        request map)."""
+        self._epoch += 1
+        self._inflight = 0
+        f = self.follower
+        # NB: the rewind target is deliberately NOT floored at log.start_index
+        # — next_index < start_index is exactly what routes _fill_window into
+        # the snapshot-install path for a follower behind the purged log.
+        if rewind_to is not None:
+            target = max(rewind_to, 0)
+            if target <= f.match_index:
+                # The follower's INCONSISTENCY hint is authoritative: it has
+                # lost entries past its recorded match (possible only with a
+                # volatile log, e.g. memory-log restart) — regress the match
+                # so commit quorum math stays honest.
+                f.match_index = target - 1
+                self.division.on_follower_match_regressed(f)
+            f.next_index = target
+        else:
+            f.next_index = max(f.match_index + 1, 0)
+        if backoff_s > 0:
+            self._backoff_until = time.monotonic() + backoff_s
+        self._wake.set()
+
+    def _fill_window(self) -> None:
+        """Issue batches until the window is full or the log is drained."""
         div = self.division
-        while self._running and div.is_leader():
-            request = self._build_request()
+        log = div.state.log
+        f = self.follower
+        while (self._running and div.is_leader()
+               and self._inflight < self.window_limit
+               and not f.snapshot_in_progress):
+            next_idx = f.next_index
+            if next_idx >= log.next_index:
+                return  # fully caught up (at send level)
+            request = self._build_request(next_idx)
             if request is None:
-                # follower is behind the purged log -> snapshot path
-                handled = await div.try_install_snapshot(self.follower)
-                if not handled:
-                    await asyncio.sleep(self.heartbeat_interval_s)
-                continue
-            try:
-                reply = await div.server.send_server_rpc(
-                    self.follower.peer_id, request)
-            except Exception:
-                await asyncio.sleep(self.heartbeat_interval_s)
-                continue
-            if not self._running or not div.is_leader():
-                break
-            await self._on_reply(request, reply)
-            # Idle wait: wake on new entries or heartbeat deadline
-            if self.follower.next_index >= div.state.log.next_index:
-                self._wake.clear()
-                try:
-                    await asyncio.wait_for(self._wake.wait(),
-                                           self.heartbeat_interval_s)
-                except asyncio.TimeoutError:
-                    pass
+                # behind the purged log -> snapshot path, serialized by the
+                # snapshot_in_progress flag inside try_install_snapshot
+                self._spawn(self._install_snapshot())
+                return
+            if not request.entries:
+                return
+            f.next_index = request.entries[-1].index + 1
+            self._inflight += 1
+            self._last_send_s = time.monotonic()
+            self._spawn(self._send(request, self._epoch, pipelined=True))
+
+    def _spawn(self, coro) -> None:
+        t = asyncio.create_task(coro)
+        self._pending_sends.add(t)
+        t.add_done_callback(self._pending_sends.discard)
+
+    async def _install_snapshot(self) -> None:
+        div = self.division
+        handled = await div.try_install_snapshot(self.follower)
+        if handled:
+            self._wake.set()
+
+    async def _send(self, request: AppendEntriesRequest, epoch: int,
+                    pipelined: bool) -> None:
+        div = self.division
+        try:
+            reply = await div.server.send_server_rpc(
+                self.follower.peer_id, request)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            if epoch == self._epoch and self._running:
+                # Connection trouble: drop the pipeline, retry after a pause
+                # paced by the heartbeat timer (GrpcLogAppender.onError).
+                self._reset_window(backoff_s=self.heartbeat_interval_s)
+            return
+        if epoch != self._epoch or not self._running:
+            return  # window was reset while this was in flight
+        if pipelined:
+            self._inflight -= 1
+        await self._on_reply(request, reply, epoch)
+        self._wake.set()
 
     async def _on_reply(self, request: AppendEntriesRequest,
-                        reply: AppendEntriesReply) -> None:
+                        reply: AppendEntriesReply, epoch: int) -> None:
         div = self.division
         if reply.term > div.state.current_term:
             await div.change_to_follower(reply.term, leader_id=None,
                                          reason="higher term in append reply")
             return
         if reply.result == AppendResult.SUCCESS:
-            last_sent = (request.entries[-1].index if request.entries
-                         else (request.previous.index if request.previous else -1))
-            self.follower.next_index = max(self.follower.next_index, last_sent + 1)
             self.follower.commit_index = max(self.follower.commit_index,
                                              reply.follower_commit)
             if self.follower.update_match(reply.match_index):
@@ -220,10 +298,59 @@ class LogAppender:
             else:
                 div.on_follower_heartbeat_ack(self.follower)
         elif reply.result == AppendResult.INCONSISTENCY:
-            self.follower.decrease_next_index(reply.next_index)
+            if epoch == self._epoch:
+                hint = min(reply.next_index,
+                           max(request.previous.index if request.previous
+                               else 0, 0))
+                self._reset_window(rewind_to=hint)
         elif reply.result == AppendResult.NOT_LEADER:
             # stale term on our side already handled above; otherwise ignore
             pass
+
+    # --------------------------------------------------------------- loops
+
+    async def _run(self) -> None:
+        div = self.division
+        # Initial empty append: announces leadership and probes the follower
+        # log position right away (the reference appender sends immediately
+        # on start; followers learn leader identity from this probe).
+        probe = self._build_request(self.follower.next_index, heartbeat=True)
+        if probe is not None:
+            self._last_send_s = time.monotonic()
+            self._spawn(self._send(probe, self._epoch, pipelined=False))
+        while self._running and div.is_leader():
+            now = time.monotonic()
+            if now < self._backoff_until:
+                await asyncio.sleep(self._backoff_until - now)
+                continue
+            self._wake.clear()
+            self._fill_window()
+            try:
+                await asyncio.wait_for(self._wake.wait(),
+                                       self.heartbeat_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _heartbeat_loop(self) -> None:
+        """Dedicated heartbeat channel: an empty AppendEntries goes out
+        whenever nothing else has been sent for an interval, regardless of
+        window occupancy (GrpcLogAppender.java:172 heartbeat stream)."""
+        div = self.division
+        while self._running and div.is_leader():
+            await asyncio.sleep(self.heartbeat_interval_s)
+            if not self._running or not div.is_leader():
+                return
+            div.check_follower_slowness(self.follower)
+            if (time.monotonic() - self._last_send_s
+                    < self.heartbeat_interval_s * 0.9):
+                continue  # recent traffic doubles as a heartbeat
+            if time.monotonic() < self._backoff_until:
+                continue
+            hb = self._build_request(self.follower.next_index, heartbeat=True)
+            if hb is None:
+                continue  # snapshot path owns this follower right now
+            self._last_send_s = time.monotonic()
+            self._spawn(self._send(hb, self._epoch, pipelined=False))
 
 
 class LeaderContext:
@@ -245,6 +372,8 @@ class LeaderContext:
         self._heartbeat_interval_s = hb
         self._buffer_byte_limit = \
             RaftServerConfigKeys.Log.Appender.buffer_byte_limit(p)
+        self._window_limit = \
+            RaftServerConfigKeys.Log.Appender.pipeline_window(p)
         from ratis_tpu.metrics import LogAppenderMetrics
         self.appender_metrics = LogAppenderMetrics(division.member_id)
 
@@ -262,7 +391,7 @@ class LeaderContext:
         info = FollowerInfo(peer_id, next_index)
         self.followers[peer_id] = info
         appender = LogAppender(self.division, info, self._heartbeat_interval_s,
-                               self._buffer_byte_limit)
+                               self._buffer_byte_limit, self._window_limit)
         self.appenders[peer_id] = appender
         self.appender_metrics.add_follower_gauges(
             peer_id, lambda i=info: i.next_index,

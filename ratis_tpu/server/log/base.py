@@ -93,6 +93,12 @@ class RaftLog:
     def flush_index(self) -> int:
         raise NotImplementedError
 
+    @property
+    def failed(self) -> bool:
+        """True once the log has latched dead on an IO failure: a node whose
+        log cannot accept writes must not campaign or lead."""
+        return False
+
     def get_last_entry_term_index(self) -> Optional[TermIndex]:
         raise NotImplementedError
 
@@ -160,8 +166,14 @@ class RaftLog:
             # else: already have it; skip
         if truncate_at is not None:
             await self.truncate(truncate_at)
-        for e in to_append:
-            await self.append_entry(e)
+        # Queue the whole batch, await durability once: the shared worker
+        # fsyncs in submission order, so the last entry's flush implies the
+        # rest are on disk — one fsync per batch instead of one per entry
+        # (the reference's LogWorker coalesces identically).
+        for e in to_append[:-1]:
+            await self.append_entry(e, wait_flush=False)
+        if to_append:
+            await self.append_entry(to_append[-1])
         return self.next_index - 1
 
     async def truncate(self, index: int) -> None:

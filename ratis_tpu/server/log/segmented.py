@@ -227,6 +227,10 @@ class SegmentedRaftLog(RaftLog):
         from ratis_tpu.metrics import SegmentedRaftLogMetrics
         self.metrics = SegmentedRaftLogMetrics(name)
 
+    @property
+    def failed(self) -> bool:
+        return self._failed is not None
+
     # ------------------------------------------------------------- recovery
 
     async def open(self, last_index_on_snapshot: int = INVALID_LOG_INDEX) -> None:

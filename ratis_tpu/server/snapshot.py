@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import pathlib
+import time
 from typing import AsyncIterator, Optional
 
 from ratis_tpu.protocol.exceptions import InstallSnapshotException
@@ -203,6 +204,9 @@ class SnapshotSender:
                     request_index += 1
                     reply = await div.server.send_server_rpc(
                         follower.peer_id, req)
+                    # A chunk reply is proof of life: refresh the response
+                    # clock so slowness detection doesn't fire mid-install.
+                    follower.last_rpc_response_s = time.monotonic()
                     if reply.result == InstallSnapshotResult.ALREADY_INSTALLED:
                         follower.next_index = max(follower.next_index,
                                                   snapshot.index + 1)

@@ -35,6 +35,12 @@ class SimulatedNetwork:
         self.base_delay_ms = base_delay_ms
         self.jitter_ms = jitter_ms
         self._rng = random.Random(seed)
+        # Per-link FIFO clock: the real transports run over TCP connections,
+        # which deliver in send order even when latency varies — pipelined
+        # appenders rely on that.  Each (src, dst) link remembers its last
+        # scheduled delivery instant; a later send is never delivered before
+        # an earlier one on the same link.
+        self._link_clock: dict[tuple[object, object], float] = {}
         # (src, dst) peer-id pairs currently blackholed
         self._blocked: set[tuple[Optional[RaftPeerId], Optional[RaftPeerId]]] = set()
         self.request_timeout_s = 3.0
@@ -85,12 +91,20 @@ class SimulatedNetwork:
     def lookup_addr(self, address: str) -> Optional["SimulatedServerTransport"]:
         return self._endpoints.get(address)
 
-    async def _hop_delay(self) -> None:
+    async def _hop_delay(self, link: Optional[tuple] = None) -> None:
         d = self.base_delay_ms
         if self.jitter_ms:
             d += self._rng.uniform(0, self.jitter_ms)
-        if d > 0:
+        if d <= 0:
+            return
+        if link is None:
             await asyncio.sleep(d / 1e3)
+            return
+        loop = asyncio.get_event_loop()
+        now = loop.time()
+        at = max(now + d / 1e3, self._link_clock.get(link, 0.0) + 1e-6)
+        self._link_clock[link] = at
+        await asyncio.sleep(at - now)
 
     # -- delivery ------------------------------------------------------------
 
@@ -100,12 +114,12 @@ class SimulatedNetwork:
         target = self.lookup_id(dst)
         if target is None or not target.running:
             raise TimeoutIOException(f"simulated: {dst} unreachable")
-        await self._hop_delay()
+        await self._hop_delay((src, dst))
         reply = await asyncio.wait_for(target.server_handler(msg),
                                        self.request_timeout_s)
         if self.is_blocked(dst, src):  # reply path can be blocked too
             raise TimeoutIOException(f"simulated: {dst}->{src} blocked")
-        await self._hop_delay()
+        await self._hop_delay((dst, src))
         return reply
 
     async def deliver_client_request(self, address: str,

@@ -1,0 +1,92 @@
+"""Pipelined appender under injected network latency.
+
+Mirrors the reference's motivation for GrpcLogAppender's streaming pipeline
+(GrpcLogAppender.java:343-381): with real per-hop latency, a stop-and-wait
+appender commits at most one batch per RTT per follower, while a pipelined
+window keeps the link full.  The simulated hub delivers per-link FIFO like
+the TCP-based transports, so the window stays coherent.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ratis_tpu.conf import RaftServerConfigKeys
+from tests.minicluster import MiniCluster, fast_properties
+
+
+async def _drive_writes(window: int, delay_ms: float, n: int) -> float:
+    """Seconds to commit n 1-entry batches through a 3-peer cluster whose
+    every hop costs delay_ms, with the given per-follower pipeline window."""
+    p = fast_properties()
+    # Elections must tolerate 2x delay round trips comfortably.
+    RaftServerConfigKeys.Rpc.set_timeout(p, "500ms", "1000ms")
+    # 1-byte budget -> every AppendEntries carries exactly one entry, so the
+    # appender cannot hide latency behind giant batches; the window is the
+    # only lever (this isolates pipelining, like the reference's perf tests).
+    p.set(RaftServerConfigKeys.Log.Appender.BUFFER_BYTE_LIMIT_KEY, "1")
+    p.set(RaftServerConfigKeys.Log.Appender.PIPELINE_WINDOW_KEY, str(window))
+    cluster = MiniCluster(3, properties=p)
+    await cluster.start()
+    try:
+        await cluster.wait_for_leader()
+        assert (await cluster.send_write()).success  # leader ready + warm
+        cluster.network.base_delay_ms = delay_ms
+        t0 = time.monotonic()
+        replies = await asyncio.gather(
+            *(cluster.send(b"INCREMENT", timeout=60.0) for _ in range(n)))
+        elapsed = time.monotonic() - t0
+        assert all(r.success for r in replies)
+    finally:
+        cluster.network.base_delay_ms = 0.0
+        await cluster.close()
+    return elapsed
+
+
+def test_pipeline_beats_stop_and_wait():
+    """>=4x speedup over a window of 1 at 20ms hop latency (VERDICT round-1
+    acceptance: GrpcLogAppender-style pipelining must actually pay off)."""
+
+    async def main():
+        n = 24
+        stop_and_wait = await _drive_writes(window=1, delay_ms=20.0, n=n)
+        pipelined = await _drive_writes(window=16, delay_ms=20.0, n=n)
+        # window=1 needs ~n RTTs (~1.9s at 40ms RTT); window=16 needs ~n/16,
+        # plus the shared client/commit path. Demand the headline 4x.
+        assert pipelined * 4 <= stop_and_wait, (
+            f"pipelined={pipelined:.3f}s stop_and_wait={stop_and_wait:.3f}s")
+
+    asyncio.run(main())
+
+
+def test_pipeline_correct_under_jitter():
+    """Replies complete out of order under jitter; counter must still reach
+    exactly n (per-link FIFO + epoch resets keep the window coherent)."""
+
+    async def main():
+        p = fast_properties()
+        RaftServerConfigKeys.Rpc.set_timeout(p, "500ms", "1000ms")
+        p.set(RaftServerConfigKeys.Log.Appender.BUFFER_BYTE_LIMIT_KEY, "1")
+        cluster = MiniCluster(3, properties=p)
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            cluster.network.base_delay_ms = 2.0
+            cluster.network.jitter_ms = 8.0
+            n = 30
+            replies = await asyncio.gather(
+                *(cluster.send(b"INCREMENT", timeout=60.0) for _ in range(n)))
+            assert all(r.success for r in replies)
+            cluster.network.base_delay_ms = 0.0
+            cluster.network.jitter_ms = 0.0
+            last = leader.state.log.get_last_committed_index()
+            await cluster.wait_applied(last)
+            for d in cluster.divisions():
+                assert d.state_machine.counter == n
+        finally:
+            cluster.network.base_delay_ms = 0.0
+            cluster.network.jitter_ms = 0.0
+            await cluster.close()
+
+    asyncio.run(main())
