@@ -1,70 +1,103 @@
-"""Benchmark: batched quorum-engine throughput vs the scalar per-group path.
+"""Benchmark: the BASELINE.md ladder, end to end, plus the kernel microbench.
 
-Measures the north-star axis from BASELINE.json: how many per-group
-consensus updates per second the host can drive when consensus math for all
-groups runs as ONE fused XLA dispatch (``ops.quorum.engine_step`` over a
-[10k groups x 8 peers] batch with 4096-event ack batches), versus the
-reference architecture's cost model — one scalar update per group per event
-loop pass (``ops.reference``, the faithful port of
-LeaderStateImpl.updateCommit + checkLeadership that the batched kernels are
-differentially tested against).
+Two measurements, reported as ONE JSON line:
 
-Prints ONE JSON line:
-  {"metric": "group_updates_per_sec", "value": N, "unit": "groups/s",
-   "vs_baseline": ratio}
+1. **End-to-end (primary)** — aggregate commits/sec + p50/p99 commit latency
+   across N RaftGroups hosted on an in-process 3-server trio with the
+   batched quorum engine engaged on every tick
+   (ratis_tpu.tools.bench_cluster; ladder rungs from BASELINE.json.configs:
+   1 group, 64 groups, 1024 groups).  ``vs_baseline`` compares the batched
+   engine against the same harness with the engine in per-group scalar mode
+   — the reference's cost shape (one Python pass per group per event, the
+   shape of LeaderStateImpl.updateCommit's per-division EventProcessor) —
+   at the headline group count.  The e2e rungs run on the CPU platform: the
+   consensus runtime is host-side asyncio and the only real TPU chip in the
+   harness is reached over a tunnel whose per-tick round-trip would measure
+   the tunnel, not the framework.
+2. **Kernel (secondary)** — fused engine_step dispatch rate over a
+   [10k groups x 8 peers] batch on the default (real TPU when present)
+   platform vs the pure-Python scalar loop: the batching-effect measure
+   from round 1.
 
-where vs_baseline is the speedup of the batched dispatch over the scalar
-loop measured on this same host (the reference publishes no numbers of its
-own — BASELINE.md).
+Run: ``python bench.py``.  Prints exactly one JSON line.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+HEADLINE_GROUPS = int(os.environ.get("RATIS_BENCH_GROUPS", "1024"))
+WRITES_PER_GROUP = int(os.environ.get("RATIS_BENCH_WRITES", "8"))
 
 
-def bench_batched(num_groups: int, num_peers: int, num_events: int,
-                  warmup: int = 3, iters: int = 30) -> float:
+# --------------------------------------------------------------- children
+
+def _force_cpu_platform() -> None:
+    """The ambient axon (remote-TPU) plugin dials a tunnel at backend init;
+    drop it and pin the CPU platform (same trick as tests/conftest.py)."""
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def child_e2e(spec: str) -> None:
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_bench
+
+    cfg = json.loads(spec)
+
+    async def main():
+        out = await run_bench(cfg["groups"], cfg["writes"],
+                              batched=cfg["batched"],
+                              concurrency=cfg.get("concurrency", 128))
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
+def child_kernel() -> None:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from __graft_entry__ import _example_batch
-    from ratis_tpu.ops.quorum import engine_step
-
-    args = _example_batch(num_groups, num_peers, num_events)
-    device_args = [jnp.asarray(a) for a in args]
-    step = jax.jit(engine_step)
-
-    out = None
-    for _ in range(warmup):
-        out = step(*device_args)
-    jax.block_until_ready(out)
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step(*device_args)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    return num_groups * iters / dt
-
-
-def bench_scalar(num_groups: int, num_peers: int, iters: int = 3) -> float:
-    """Reference cost model: the same math one group at a time (the shape of
-    the Java EventProcessor's per-division updateCommit pass)."""
-    from __graft_entry__ import _example_batch
+    from ratis_tpu.ops import quorum as q
     from ratis_tpu.ops import reference as ref
 
-    (match_index, last_ack_ms, _eg, _ep, _em, _et, _ev, self_mask,
-     flush_index, conf_cur, conf_old, commit_index, first_leader_index,
-     role, _dl, now_ms, lead_timeout) = _example_batch(num_groups, num_peers, 1)
-
-    self_slot = np.zeros(num_groups, np.int32)
+    G, P, E = 10_240, 8, 4096
+    args = _example_batch(G, P, E)
+    device_args = [jnp.asarray(a) for a in args]
+    step = jax.jit(q.engine_step)
+    out = None
+    for _ in range(3):
+        out = step(*device_args)
+    jax.block_until_ready(out)
     t0 = time.perf_counter()
+    iters = 30
     for _ in range(iters):
-        for g in range(num_groups):
+        out = step(*device_args)
+    jax.block_until_ready(out)
+    batched = G * iters / (time.perf_counter() - t0)
+
+    # Scalar loop cost model: same math, one group at a time (sampled and
+    # extrapolated — per-group cost is a flat Python loop).
+    (match_index, last_ack_ms, _eg, _ep, _em, _et, _ev, _sm, flush_index,
+     conf_cur, conf_old, commit_index, first_leader_index, role, _dl,
+     now_ms, lead_timeout) = _example_batch(2048, P, 1)
+    self_slot = np.zeros(2048, np.int32)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        for g in range(2048):
             ref.update_commit(
                 match_index[g].tolist(), int(self_slot[g]),
                 int(flush_index[g]), conf_cur[g].tolist(),
@@ -74,23 +107,79 @@ def bench_scalar(num_groups: int, num_peers: int, iters: int = 3) -> float:
                 last_ack_ms[g].tolist(), int(self_slot[g]),
                 conf_cur[g].tolist(), conf_old[g].tolist(),
                 int(now_ms), int(lead_timeout), bool(role[g] == 3))
-    dt = time.perf_counter() - t0
-    return num_groups * iters / dt
+    scalar = 2048 * 3 / (time.perf_counter() - t0)
+    print("RESULT " + json.dumps({
+        "group_updates_per_sec": round(batched, 1),
+        "vs_scalar_loop": round(batched / scalar, 2),
+        "platform": str(jax.devices()[0]),
+    }))
 
+
+def _run_child(args: list[str], timeout_s: float = 900.0) -> dict:
+    t0 = time.monotonic()
+    print(f"bench: running {args} ...", file=sys.stderr, flush=True)
+    proc = subprocess.run(
+        [sys.executable, __file__] + args, capture_output=True, text=True,
+        timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            print(f"bench: {args} done in {time.monotonic() - t0:.0f}s",
+                  file=sys.stderr, flush=True)
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(
+        f"child {args} produced no RESULT; rc={proc.returncode} "
+        f"stderr tail: {proc.stderr[-2000:]}")
+
+
+# ----------------------------------------------------------------- driver
 
 def main() -> None:
-    G, P, E = 10_240, 8, 4096
-    batched = bench_batched(G, P, E)
-    # Scalar loop is slow by design; sample fewer groups and extrapolate
-    # (per-group cost is constant — it is a flat Python loop).
-    scalar = bench_scalar(2048, P)
+    ladder = {}
+    for groups, writes, conc in ((1, 256, 32), (64, WRITES_PER_GROUP, 128),
+                                 (HEADLINE_GROUPS, WRITES_PER_GROUP, 128)):
+        if groups in ladder:
+            continue
+        spec = json.dumps({"groups": groups, "writes": writes,
+                           "batched": True, "concurrency": conc})
+        ladder[groups] = _run_child(["--e2e-child", spec])
+
+    headline = ladder[HEADLINE_GROUPS]
+    scalar_spec = json.dumps({"groups": HEADLINE_GROUPS,
+                              "writes": WRITES_PER_GROUP,
+                              "batched": False, "concurrency": 128})
+    scalar = _run_child(["--e2e-child", scalar_spec])
+    kernel = _run_child(["--kernel-child"])
+
     print(json.dumps({
-        "metric": "group_updates_per_sec",
-        "value": round(batched, 1),
-        "unit": "groups/s",
-        "vs_baseline": round(batched / scalar, 2),
+        "metric": "aggregate_commits_per_sec",
+        "value": headline["commits_per_sec"],
+        "unit": "commits/s",
+        "vs_baseline": round(headline["commits_per_sec"]
+                             / scalar["commits_per_sec"], 2),
+        "vs_baseline_definition": (
+            "batched engine vs scalar per-group engine mode, same harness "
+            "and group count (Apache Ratis publishes no numbers to compare "
+            "against - BASELINE.md); kernel_vs_scalar_loop is the batching "
+            "effect vs the reference's per-group cost shape"),
+        "secondary": {
+            "groups": HEADLINE_GROUPS,
+            "p50_ms": headline["p50_ms"],
+            "p99_ms": headline["p99_ms"],
+            "election_convergence_s": headline["election_convergence_s"],
+            "scalar_mode_commits_per_sec": scalar["commits_per_sec"],
+            "ladder": {str(g): r["commits_per_sec"]
+                       for g, r in sorted(ladder.items())},
+            "kernel_group_updates_per_sec": kernel["group_updates_per_sec"],
+            "kernel_vs_scalar_loop": kernel["vs_scalar_loop"],
+            "kernel_platform": kernel["platform"],
+        },
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--e2e-child":
+        child_e2e(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--kernel-child":
+        child_kernel()
+    else:
+        main()

@@ -134,6 +134,12 @@ class RaftServerConfigKeys:
                               RaftServerConfigKeys.Log.SEGMENT_SIZE_MAX_DEFAULT)
 
         @staticmethod
+        def segment_cache_num_max(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Log.SEGMENT_CACHE_NUM_MAX_KEY,
+                RaftServerConfigKeys.Log.SEGMENT_CACHE_NUM_MAX_DEFAULT)
+
+        @staticmethod
         def force_sync_num(p: RaftProperties) -> int:
             return p.get_int(RaftServerConfigKeys.Log.FORCE_SYNC_NUM_KEY,
                              RaftServerConfigKeys.Log.FORCE_SYNC_NUM_DEFAULT)

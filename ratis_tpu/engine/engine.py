@@ -28,6 +28,22 @@ from ratis_tpu.engine.state import (GroupBatchState, NO_DEADLINE,
                                     ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
 from ratis_tpu.ops import reference as ref
 
+_SHARED_STEP = None
+
+
+def _shared_step():
+    """Process-wide jitted resident step (see QuorumEngine._kernels)."""
+    global _SHARED_STEP
+    if _SHARED_STEP is None:
+        import jax
+
+        from ratis_tpu.ops import quorum as q
+        # Donating the DeviceState keeps the [G, P] batch resident on
+        # device: each tick consumes the old buffers and returns new ones
+        # without a host round-trip.
+        _SHARED_STEP = jax.jit(q.engine_step_resident, donate_argnums=(0,))
+    return _SHARED_STEP
+
 
 class EngineListener(Protocol):
     """What a division implements to be driven by the engine."""
@@ -73,7 +89,6 @@ class QuorumEngine:
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._running = False
-        self._jit_cache: dict = {}
         # Device-resident copy of the batch state (ops.quorum.DeviceState);
         # None until the first batched tick, invalidated on rebase/regrow.
         self._dev = None
@@ -255,15 +270,34 @@ class QuorumEngine:
     # -- batched path --------------------------------------------------------
 
     def _kernels(self):
-        if "step" not in self._jit_cache:
-            import jax
-            from ratis_tpu.ops import quorum as q
-            # Donating the DeviceState keeps the [G, P] batch resident on
-            # device: each tick consumes the old buffers and returns new ones
-            # without a host round-trip.
-            self._jit_cache["step"] = jax.jit(q.engine_step_resident,
-                                              donate_argnums=(0,))
-        return self._jit_cache["step"]
+        # One process-wide jitted step: the kernel is pure and every engine
+        # in the process (one per co-hosted server) shares shapes, so a
+        # shared wrapper compiles each shape bucket once instead of once
+        # per server.
+        return _shared_step()
+
+    def prewarm(self, group_counts=(64, 256, 1024),
+                event_counts=(64, 256, 1024)) -> None:
+        """Compile the batched kernel for the standard pad buckets up front.
+
+        XLA compiles per shape signature; without prewarming, the first tick
+        that hits a new (dirty-rows, events) bucket stalls the event loop for
+        the compile — long enough on slow backends to fire election timeouts
+        and churn leadership mid-benchmark.  Runs the real tick path against
+        the current (zero/idle) state; listeners never fire because outputs
+        are filtered by the active set."""
+        s = self.state
+        now = self.clock.now_ms()
+        saved_dirty = set(s.dirty)
+        for dc in group_counts:
+            if dc > s.capacity:
+                continue
+            for ec in event_counts:
+                s.dirty = set(range(dc))
+                acks = [(0, 0, -1, now)] * ec
+                self._tick_batched(acks, now)
+        s.dirty = saved_dirty
+        self._dev = None  # drop the prewarm device copy; re-upload on use
 
     def _upload_device_state(self):
         import jax.numpy as jnp
@@ -281,6 +315,17 @@ class QuorumEngine:
     def _pow2(n: int) -> int:
         return 1 << (max(1, n) - 1).bit_length()
 
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Pad size for event/dirty batches: 64 * 4^k.  Coarser than plain
+        pow2 so the jit compiles O(few) shape buckets instead of one per
+        power of two — padding costs bytes, recompiles cost tens of
+        milliseconds (CPU) to tens of seconds (remote TPU)."""
+        b = 64
+        while b < n:
+            b *= 4
+        return b
+
     def _tick_batched(self, acks, now: int) -> list[tuple[int, str, int]]:
         import jax.numpy as jnp
 
@@ -297,14 +342,14 @@ class QuorumEngine:
         dirty = sorted(s.dirty)
         s.dirty.clear()
         self.metrics["refresh_rows"] += len(dirty)
-        dcap = self._pow2(len(dirty))
+        dcap = self._bucket(len(dirty))
         # padded entries point one past the end -> dropped by the scatter
         rf_idx = np.full(dcap, s.capacity, np.int32)
         rf_idx[:len(dirty)] = dirty
         gi = np.minimum(rf_idx, s.capacity - 1)  # in-range gather indices
 
         # packed ack events: O(events) host->device
-        ecap = self._pow2(len(acks))
+        ecap = self._bucket(len(acks))
         evg = np.zeros(ecap, np.int32)
         evp = np.zeros(ecap, np.int32)
         evm = np.zeros(ecap, np.int32)

@@ -125,6 +125,11 @@ class SegmentedRaftLogMetrics(_MetricsBase):
         self.append_timer = r.timer("appendEntryLatency")
         self.truncate_count = r.counter("truncateLogCount")
         self.purge_count = r.counter("purgeLogCount")
+        # entry-cache eviction + read-through (reference raft_log cache
+        # hit/miss counters, SegmentedRaftLogMetrics.java)
+        self.cache_hit_count = r.counter("cacheHitCount")
+        self.cache_miss_count = r.counter("cacheMissCount")
+        self.cache_evict_count = r.counter("cacheEvictCount")
 
 
 class LogAppenderMetrics(_MetricsBase):

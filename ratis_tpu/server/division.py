@@ -153,6 +153,10 @@ class Division:
         # Fire-and-forget notification tasks: the loop holds only weak refs,
         # so keep strong ones until completion or GC may drop them unrun.
         self._bg_tasks: set[asyncio.Task] = set()
+        self._no_leader_timeout_s = \
+            RaftServerConfigKeys.Notification.no_leader_timeout(p).seconds
+        self._last_no_leader_notify_s = 0.0
+        self._started_at_s = 0.0
 
         # admin state
         self.pending_reconf = None  # Optional[admin.PendingReconf]
@@ -312,6 +316,7 @@ class Division:
 
     async def start(self) -> None:
         self._running = True
+        self._started_at_s = asyncio.get_event_loop().time()
         snapshot_index = -1
         if self.storage is not None:
             # RECOVER path (reference ServerState.initialize:134): reload
@@ -425,7 +430,26 @@ class Division:
             self.reset_election_deadline()
             return
         self.election_metrics.timeout_count.inc()
+        self._check_extended_no_leader()
         await self.change_to_candidate()
+
+    def _check_extended_no_leader(self) -> None:
+        """Reference RaftServerImpl.checkExtendedNoLeader (via
+        StateMachine.notifyExtendedNoLeader, StateMachine.java:255): at each
+        election timeout, if no leader has been heard for
+        Notification.no_leader_timeout, tell the state machine — at most
+        once per timeout period."""
+        if self._no_leader_timeout_s <= 0:
+            return
+        now = asyncio.get_event_loop().time()
+        base = max(self._last_heard_leader_s, self._started_at_s)
+        if now - base < self._no_leader_timeout_s:
+            return
+        if now - self._last_no_leader_notify_s < self._no_leader_timeout_s:
+            return
+        self._last_no_leader_notify_s = now
+        self._spawn_bg(self.state_machine.notify_extended_no_leader(
+            self.role_info()))
 
     async def on_commit_advance(self, new_commit: int) -> None:
         """Engine advanced this group's commit (leader only)."""
@@ -1411,6 +1435,7 @@ class Division:
                 self._applied_index = index
                 sm.update_last_applied_term_index(entry.term, entry.index)
             self.applied_waiters.advance(self._applied_index)
+            log.evict_cache(self._applied_index)
             if self.is_leader() and self.leader_ctx is not None \
                     and not self.leader_ctx.leader_ready.done() \
                     and self._applied_index >= self.leader_ctx.startup_index >= 0:

@@ -75,6 +75,9 @@ class PendingRequests:
             self._bytes -= p.request.message.size()
         return p
 
+    def requests(self) -> list[RaftClientRequest]:
+        return [p.request for p in self._map.values()]
+
     def drain_not_leader(self, exception: NotLeaderException) -> int:
         """Step-down: fail everything (PendingRequests.notifyNotLeader)."""
         n = len(self._map)
@@ -114,9 +117,12 @@ class LogAppender:
     up to ``window_limit`` AppendEntries requests are in flight at once —
     ``follower.next_index`` is the optimistic *send* cursor, advanced when a
     batch is handed to the transport, while ``follower.match_index`` advances
-    only on acks.  Replies may complete out of order; all transports deliver
-    per-link FIFO (TCP streams; the simulated hub models the same), so the
-    follower observes batches in send order.  A dedicated heartbeat timer
+    only on acks.  Replies may complete out of order.  Per-link FIFO
+    delivery (TCP/simulated transports) keeps the pipeline efficient; it is
+    NOT a correctness requirement: reordered delivery (possible with
+    concurrent unary gRPC handlers) at worst produces a spurious
+    INCONSISTENCY -> window reset + resend, and match only ever advances
+    from per-request-capped SUCCESS confirmations.  A dedicated heartbeat timer
     (reference's separate heartbeat channel, GrpcLogAppender.java:172) fires
     outside the window and is never queued behind a full pipeline.  On
     INCONSISTENCY or an RPC error the window resets: the epoch is bumped so
@@ -140,6 +146,7 @@ class LogAppender:
         self._inflight = 0     # pipelined (non-heartbeat) requests outstanding
         self._last_send_s = 0.0
         self._backoff_until = 0.0
+        self._prefaulting = False
         self._pending_sends: set[asyncio.Task] = set()
 
     def start(self) -> None:
@@ -238,6 +245,14 @@ class LogAppender:
             next_idx = f.next_index
             if next_idx >= log.next_index:
                 return  # fully caught up (at send level)
+            if not log.is_resident(next_idx):
+                # evicted segment: fault it in off-loop, then resume — a
+                # synchronous multi-MB read+decode here would stall every
+                # division's heartbeats and election timers
+                if not self._prefaulting:
+                    self._prefaulting = True
+                    self._spawn(self._prefault(next_idx))
+                return
             request = self._build_request(next_idx)
             if request is None:
                 # behind the purged log -> snapshot path, serialized by the
@@ -261,6 +276,13 @@ class LogAppender:
         handled = await div.try_install_snapshot(self.follower)
         if handled:
             self._wake.set()
+
+    async def _prefault(self, index: int) -> None:
+        try:
+            await asyncio.to_thread(self.division.state.log.prefault, index)
+        finally:
+            self._prefaulting = False
+        self._wake.set()
 
     async def _send(self, request: AppendEntriesRequest, epoch: int,
                     pipelined: bool) -> None:
@@ -293,7 +315,16 @@ class LogAppender:
         if reply.result == AppendResult.SUCCESS:
             self.follower.commit_index = max(self.follower.commit_index,
                                              reply.follower_commit)
-            if self.follower.update_match(reply.match_index):
+            # Cap the confirmed match at what THIS request actually verified
+            # against our log (prev check + entries sent).  The follower's
+            # raw flush_index may cover a stale tail from a previous term
+            # that a heartbeat never examined; counting it toward quorum
+            # could commit entries that are not truly replicated.
+            last_covered = (request.entries[-1].index if request.entries
+                            else (request.previous.index if request.previous
+                                  else -1))
+            confirmed = min(reply.match_index, last_covered)
+            if self.follower.update_match(confirmed):
                 div.on_follower_ack(self.follower)
             else:
                 div.on_follower_heartbeat_ack(self.follower)
@@ -416,6 +447,17 @@ class LeaderContext:
         self.appenders.clear()
         self.appender_metrics.unregister()
         if exception is not None:
+            # StateMachine.notifyNotLeader (StateMachine.java:241): the SM
+            # sees the client requests that will never commit here, before
+            # their futures fail with NotLeaderException.
+            pending_reqs = self.pending.requests()
+            if pending_reqs:
+                try:
+                    await self.division.state_machine.notify_not_leader(
+                        pending_reqs)
+                except Exception:
+                    LOG.exception("%s notify_not_leader raised",
+                                  self.division.member_id)
             self.pending.drain_not_leader(exception)
         if not self.leader_ready.done():
             self.leader_ready.cancel()

@@ -153,6 +153,11 @@ class RaftLog:
         to_append: list[LogEntry] = []
         truncate_at: Optional[int] = None
         for e in entries:
+            if e.index < self.start_index:
+                # Below our purge/snapshot boundary: already covered by the
+                # installed snapshot (a leader rewound past our start after
+                # a connection loss resends them) — skip, never re-append.
+                continue
             existing = self.get_term_index(e.index)
             if existing is None:
                 to_append.append(e)
@@ -183,6 +188,20 @@ class RaftLog:
     async def purge(self, index: int) -> int:
         """Drop entries <= index (snapshot-covered); returns new start-1."""
         raise NotImplementedError
+
+    def evict_cache(self, applied_index: int) -> int:
+        """Release entry memory no longer needed by the applier (the
+        segmented log overrides this; volatile logs have nothing to evict)."""
+        return 0
+
+    def is_resident(self, index: int) -> bool:
+        """False when reading ``index`` would block on a file fault (evicted
+        segment); async hot paths prefault() off-loop first."""
+        return True
+
+    def prefault(self, index: int) -> None:
+        """Blocking: fault the segment covering ``index`` into memory.
+        No-op for fully-resident logs."""
 
     def term_at_or_before(self, index: int) -> Optional[TermIndex]:
         """TermIndex for a previous-entry check; None if purged away."""

@@ -185,37 +185,86 @@ class LogWorker:
 
 
 class _Segment:
-    """One segment: entries in memory + its file."""
+    """One segment: its file, per-entry (term, offset) metadata, and — while
+    cached — the decoded entries.
+
+    Mirrors the reference LogSegment (LogSegment.java): the compact LogRecord
+    list (term + file position per entry) always stays in memory so
+    consistency checks (get_term_index / previous-entry validation) never
+    touch disk, while the entry payloads can be evicted
+    (SegmentedRaftLogCache.java evictCache) and read back through the file on
+    demand for lagging followers."""
 
     def __init__(self, start: int, path: pathlib.Path, is_open: bool):
         self.start = start
         self.path = path
         self.is_open = is_open
-        self.entries: list[LogEntry] = []
-        # byte offset in file where each entry's record begins
+        # None = evicted (payloads live only in the file)
+        self.entries: Optional[list[LogEntry]] = []
+        # always-resident metadata: term + byte offset of each record
+        self.terms: list[int] = []
         self.offsets: list[int] = []
         self.size = len(MAGIC)
 
+    def append(self, entry: LogEntry, offset: int, record_len: int) -> None:
+        assert self.entries is not None, "append to evicted segment"
+        self.entries.append(entry)
+        self.terms.append(entry.term)
+        self.offsets.append(offset)
+        self.size = offset + record_len
+
+    @property
+    def count(self) -> int:
+        return len(self.terms)
+
     @property
     def end(self) -> int:
-        return self.start + len(self.entries) - 1
+        return self.start + len(self.terms) - 1
+
+    @property
+    def cached(self) -> bool:
+        return self.entries is not None
+
+    def evict(self) -> None:
+        assert not self.is_open
+        self.entries = None
+
+    def term_at(self, index: int) -> Optional[int]:
+        i = index - self.start
+        if 0 <= i < len(self.terms):
+            return self.terms[i]
+        return None
 
     def get(self, index: int) -> Optional[LogEntry]:
         i = index - self.start
-        if 0 <= i < len(self.entries):
+        if 0 <= i < len(self.terms) and self.entries is not None:
             return self.entries[i]
         return None
+
+    def load(self) -> list[LogEntry]:
+        """Read the whole segment back from disk (read-through miss)."""
+        payloads, _ = read_records(self.path)
+        return [LogEntry.from_bytes(p) for p in payloads]
 
 
 class SegmentedRaftLog(RaftLog):
     def __init__(self, name: str, directory: pathlib.Path,
                  worker: Optional[LogWorker] = None,
-                 segment_size_max: int = 8 << 20):
+                 segment_size_max: int = 8 << 20,
+                 cache_segments_max: int = 6):
         super().__init__(name)
         self.dir = pathlib.Path(directory)
         self.worker = worker or LogWorker.shared(str(self.dir.anchor or "default"))
         self.segment_size_max = segment_size_max
+        # Closed segments beyond this many keep only (term, offset) metadata
+        # in RAM; payloads are re-read from the file on demand (reference
+        # SegmentedRaftLogCache.java default 6 cached segments).
+        self.cache_segments_max = cache_segments_max
         self._segments: list[_Segment] = []
+        # read-through cache: seg.start -> entries, tiny LRU (a couple of
+        # lagging followers scanning different segments shouldn't thrash)
+        self._rt_cache: "dict[int, list[LogEntry]]" = {}
+        self._rt_cache_max = 3
         self._open_file = None
         self._flush_index = INVALID_LOG_INDEX
         self._below_start: Optional[TermIndex] = None
@@ -263,11 +312,9 @@ class SegmentedRaftLog(RaftLog):
             off = len(MAGIC)
             for p in payloads:
                 e = LogEntry.from_bytes(p)
-                seg.entries.append(e)
-                seg.offsets.append(off)
+                seg.append(e, off, _REC_HDR.size + len(p))
                 off += _REC_HDR.size + len(p)
-            seg.size = off
-            if seg.entries or seg.is_open:
+            if seg.count or seg.is_open:
                 self._segments.append(seg)
 
         # Only the last segment may be open; close others defensively.
@@ -292,7 +339,7 @@ class SegmentedRaftLog(RaftLog):
         await super().close()
 
     def _close_segment_file(self, seg: _Segment) -> None:
-        if not seg.entries:
+        if not seg.count:
             seg.path.unlink(missing_ok=True)
             return
         new_path = seg.path.with_name(f"log_{seg.start}-{seg.end}")
@@ -316,23 +363,99 @@ class SegmentedRaftLog(RaftLog):
 
     def get_last_entry_term_index(self) -> Optional[TermIndex]:
         for seg in reversed(self._segments):
-            if seg.entries:
-                return seg.entries[-1].term_index()
+            if seg.count:
+                return TermIndex(seg.terms[-1], seg.end)
         return self._below_start
+
+    def _fault_in(self, seg: _Segment) -> list[LogEntry]:
+        entries = self._rt_cache.get(seg.start)
+        if entries is None:
+            self.metrics.cache_miss_count.inc()
+            entries = seg.load()
+            self._rt_cache[seg.start] = entries
+            while len(self._rt_cache) > self._rt_cache_max:
+                self._rt_cache.pop(next(iter(self._rt_cache)))
+        else:
+            self.metrics.cache_hit_count.inc()
+        return entries
+
+    def _read_through(self, seg: _Segment, index: int) -> Optional[LogEntry]:
+        """Serve an evicted segment from its file (one whole-segment read,
+        held in a small LRU for the sequential scans a catching-up follower
+        produces).  Synchronous: async hot paths should check is_resident()
+        first and prefault() off-loop (LogAppender does)."""
+        entries = self._fault_in(seg)
+        i = index - seg.start
+        if 0 <= i < len(entries):
+            return entries[i]
+        return None
+
+    def _covering_segment(self, index: int) -> Optional[_Segment]:
+        for seg in reversed(self._segments):
+            if seg.start <= index:
+                return seg if index <= seg.end else None
+        return None
+
+    def is_resident(self, index: int) -> bool:
+        seg = self._covering_segment(index)
+        return (seg is None or seg.cached
+                or seg.start in self._rt_cache)
+
+    def prefault(self, index: int) -> None:
+        """Blocking load of the segment covering ``index`` into the
+        read-through cache; call via asyncio.to_thread from async paths."""
+        seg = self._covering_segment(index)
+        if seg is not None and not seg.cached:
+            self._fault_in(seg)
 
     def get(self, index: int) -> Optional[LogEntry]:
         for seg in reversed(self._segments):
             if seg.start <= index:
-                return seg.get(index)
+                if index > seg.end:
+                    return None
+                if seg.cached:
+                    return seg.get(index)
+                return self._read_through(seg, index)
         return None
 
     def get_term_index(self, index: int) -> Optional[TermIndex]:
-        e = self.get(index)
-        if e is not None:
-            return e.term_index()
+        # metadata-only: never faults an evicted segment in
+        for seg in reversed(self._segments):
+            if seg.start <= index:
+                t = seg.term_at(index)
+                return TermIndex(t, index) if t is not None else None
         if self._below_start is not None and index == self._below_start.index:
             return self._below_start
         return None
+
+    # ------------------------------------------------------------- eviction
+
+    @property
+    def cached_segments(self) -> int:
+        return sum(1 for s in self._segments if not s.is_open and s.cached)
+
+    def evict_cache(self, applied_index: int) -> int:
+        """Bound entry memory (reference SegmentedRaftLogCache.evictCache):
+        keep at most cache_segments_max closed segments' payloads resident,
+        evicting oldest-first but only below the applied frontier (the
+        applier reads every entry exactly once; evicting ahead of it would
+        thrash).  Lagging followers are served from disk via read-through.
+        Returns the number of segments evicted."""
+        # cheap guard: runs on every apply batch, almost always a no-op
+        if len(self._segments) <= self.cache_segments_max + 1:
+            return 0
+        closed_cached = [s for s in self._segments
+                         if not s.is_open and s.cached]
+        excess = len(closed_cached) - self.cache_segments_max
+        evicted = 0
+        for seg in closed_cached:
+            if evicted >= excess:
+                break
+            if seg.end <= applied_index:
+                seg.evict()
+                self.metrics.cache_evict_count.inc()
+                evicted += 1
+        return evicted
 
     # ------------------------------------------------------------- append
 
@@ -372,9 +495,7 @@ class SegmentedRaftLog(RaftLog):
 
         payload = entry.to_bytes(include_sm_data=False)
         record = encode_record(payload)
-        seg.entries.append(entry)
-        seg.offsets.append(seg.size)
-        seg.size += len(record)
+        seg.append(entry, seg.size, len(record))
         fut = self.worker.submit(self._open_file, record)
         index = entry.index
 
@@ -406,6 +527,7 @@ class SegmentedRaftLog(RaftLog):
 
     async def truncate(self, index: int) -> None:
         self.metrics.truncate_count.inc()
+        self._rt_cache.clear()
         await self.worker.drain()
         while self._segments and self._segments[-1].start >= index:
             seg = self._segments.pop()
@@ -418,12 +540,15 @@ class SegmentedRaftLog(RaftLog):
             return
         seg = self._segments[-1]
         if index <= seg.end:
+            if not seg.cached:
+                seg.entries = seg.load()  # truncation rewrites the tail
             keep = index - seg.start
             byte_len = seg.offsets[keep] if keep < len(seg.offsets) else seg.size
             if seg.is_open and self._open_file is not None:
                 self._open_file.close()
                 self._open_file = None
             del seg.entries[keep:]
+            del seg.terms[keep:]
             del seg.offsets[keep:]
             with open(seg.path, "r+b") as fh:
                 fh.truncate(byte_len)
@@ -442,11 +567,12 @@ class SegmentedRaftLog(RaftLog):
         reference purges at segment granularity too (purgeImpl)."""
         ti = self.get_term_index(index)
         self.metrics.purge_count.inc()
+        self._rt_cache.clear()
         # Roll the open segment first when the snapshot fully covers it, so
         # purge can reclaim it too (otherwise a single-open-segment log would
         # never shrink after snapshotting).
         if self._segments and self._segments[-1].is_open \
-                and self._segments[-1].entries \
+                and self._segments[-1].count \
                 and self._segments[-1].end <= index:
             await self._roll_segment()
         dropped = False
@@ -462,6 +588,7 @@ class SegmentedRaftLog(RaftLog):
 
     def set_snapshot_boundary(self, ti: TermIndex) -> None:
         """After snapshot install: discard the local log below/at ti."""
+        self._rt_cache.clear()
         for seg in self._segments:
             seg.path.unlink(missing_ok=True)
         self._segments.clear()

@@ -144,6 +144,14 @@ class RaftServer:
         if self.datastream is not None:
             await self.datastream.close()
         for div in list(self.divisions.values()):
+            # whole-server shutdown (StateMachine.notifyServerShutdown,
+            # StateMachine.java:277; group_remove notifies per-group instead)
+            try:
+                await div.state_machine.notify_server_shutdown(
+                    div.role_info(), True)
+            except Exception:
+                LOG.exception("%s notify_server_shutdown raised",
+                              div.member_id)
             await div.close()
         self.divisions.clear()
         await self.engine.close()
@@ -193,7 +201,9 @@ class RaftServer:
                 f"log-{self.peer_id}-{group.group_id}", storage.current,
                 worker=LogWorker.shared(f"{self.peer_id}:{root}"),
                 segment_size_max=RaftServerConfigKeys.Log.segment_size_max(
-                    self.properties))
+                    self.properties),
+                cache_segments_max=RaftServerConfigKeys.Log
+                .segment_cache_num_max(self.properties))
         div = Division(self, group, sm, log=log, storage=storage)
         self.divisions[group.group_id] = div
         try:

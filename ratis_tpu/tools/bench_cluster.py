@@ -1,0 +1,228 @@
+"""End-to-end multi-raft benchmark harness: the framework's own load
+generator (reference analog: ratis-examples filestore LoadGen,
+ratis-examples/src/main/java/org/apache/ratis/examples/filestore/cli/LoadGen.java,
+driven against an in-process MiniRaftCluster-style trio).
+
+Spins one in-process server trio over the simulated transport (direct
+function-call RPC — measures the framework, not socket syscalls), hosts N
+sibling RaftGroups on it (the multi-raft axis, RaftServerProxy.java:89-188),
+elects all leaders, then drives concurrent counter writes through the full
+client->leader->log->appender->quorum->apply->reply path, with the batched
+quorum engine ticking every group on each server as ONE fused dispatch.
+
+Reports aggregate commits/sec + p50/p99 commit latency — the north-star
+metrics from BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from typing import Optional
+
+from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys
+from ratis_tpu.models.counter import CounterStateMachine
+from ratis_tpu.protocol.exceptions import (LeaderNotReadyException,
+                                           NotLeaderException, RaftException)
+from ratis_tpu.protocol.group import RaftGroup
+from ratis_tpu.protocol.ids import ClientId, RaftGroupId, RaftPeerId
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.peer import RaftPeer
+from ratis_tpu.protocol.requests import RaftClientRequest, write_request_type
+from ratis_tpu.server.server import RaftServer
+from ratis_tpu.transport.simulated import (SimulatedNetwork,
+                                           SimulatedTransportFactory)
+
+
+def bench_properties(batched: bool, num_groups: int = 1) -> RaftProperties:
+    from ratis_tpu.engine.engine import QuorumEngine
+    p = RaftProperties()
+    # Long timeouts: at 1k+ groups the background heartbeat volume scales
+    # with group count (one appender per follower per group, like the
+    # reference); 1s/2s keeps idle traffic at ~4k RPC/s for 1024 groups and
+    # widens the leadership-staleness window past event-loop queueing noise.
+    RaftServerConfigKeys.Rpc.set_timeout(p, "1s", "2s")
+    p.set("raft.tpu.engine.tick-interval", "2ms")
+    # Pre-size the engine so adding N groups never regrows the batch arrays
+    # (each regrow is a new kernel shape -> a compile stall mid-run).
+    p.set(RaftServerConfigKeys.Engine.MAX_GROUPS_KEY,
+          str(max(QuorumEngine._bucket(num_groups), 64)))
+    RaftServerConfigKeys.Log.set_use_memory(p, True)
+    if batched:
+        # every tick runs the jitted kernel over all groups (the TPU-native
+        # execution mode); otherwise the per-group scalar fallback runs —
+        # the reference's cost shape (one Python pass per group per event).
+        p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
+    else:
+        p.set("raft.tpu.engine.scalar-fallback-threshold", "1000000000")
+    return p
+
+
+class BenchCluster:
+    """A 3-server in-process trio hosting ``num_groups`` sibling groups."""
+
+    def __init__(self, num_groups: int, num_servers: int = 3,
+                 batched: bool = True):
+        self.num_groups = num_groups
+        self.batched = batched
+        self.network = SimulatedNetwork()
+        self.factory = SimulatedTransportFactory(self.network)
+        self.properties = bench_properties(batched, num_groups)
+        peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"), address=f"sim:s{i}")
+                 for i in range(num_servers)]
+        self.groups = [RaftGroup.value_of(RaftGroupId.random_id(), peers)
+                       for _ in range(num_groups)]
+        self.servers: list[RaftServer] = [
+            RaftServer(p.id, p.address,
+                       state_machine_registry=lambda gid: CounterStateMachine(),
+                       properties=self.properties,
+                       transport_factory=self.factory,
+                       group=self.groups[0])
+            for p in peers]
+        self._call_ids = itertools.count(1)
+        self.election_convergence_s: float = 0.0
+        self._leader_hint: dict[RaftGroupId, RaftServer] = {}
+
+    async def start(self) -> None:
+        t0 = time.monotonic()
+        if self.batched:
+            # Compile every pad bucket before elections begin: a mid-run
+            # compile stall is long enough to fire election timeouts.  The
+            # jitted step is process-shared, so one engine warms all three.
+            buckets, b = [], 64
+            from ratis_tpu.engine.engine import QuorumEngine
+            top = max(QuorumEngine._bucket(self.num_groups), 64)
+            while b <= max(top, 4096):
+                buckets.append(b)
+                b *= 4
+            self.servers[0].engine.prewarm(
+                group_counts=[x for x in buckets if x <= top],
+                event_counts=buckets)
+        await asyncio.gather(*(s.start() for s in self.servers))
+        # Wave-wise group bring-up: 1024 simultaneous election storms have a
+        # long vote-split tail under a saturated event loop; bounded waves
+        # converge in near-linear time (and mirror incremental group-add in
+        # a real deployment).
+        wave = 128
+        await self._wait_all_leaders([self.groups[0]])
+        for i in range(1, len(self.groups), wave):
+            batch = self.groups[i:i + wave]
+            for g in batch:
+                await asyncio.gather(*(s.group_add(g) for s in self.servers))
+            await self._wait_all_leaders(batch)
+        self.election_convergence_s = time.monotonic() - t0
+
+    async def _wait_all_leaders(self, groups: list[RaftGroup],
+                                timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        pending = {g.group_id for g in groups}
+        while pending and time.monotonic() < deadline:
+            done = set()
+            for gid in pending:
+                for s in self.servers:
+                    d = s.divisions.get(gid)
+                    if d is not None and d.is_leader() \
+                            and d.leader_ctx is not None \
+                            and d.leader_ctx.leader_ready.done():
+                        self._leader_hint[gid] = s
+                        done.add(gid)
+                        break
+            pending -= done
+            if pending:
+                await asyncio.sleep(0.05)
+        if pending:
+            raise TimeoutError(
+                f"{len(pending)}/{len(groups)} groups in this wave have no "
+                f"ready leader after {timeout}s")
+
+    async def close(self) -> None:
+        await asyncio.gather(*(s.close() for s in self.servers),
+                             return_exceptions=True)
+
+    # ------------------------------------------------------------- workload
+
+    async def _write(self, client, client_id: ClientId, gid: RaftGroupId,
+                     timeout: float = 60.0):
+        """One counter INCREMENT with leader-hint failover."""
+        server = self._leader_hint.get(gid, self.servers[0])
+        deadline = time.monotonic() + timeout
+        while True:
+            req = RaftClientRequest(client_id, server.peer_id, gid,
+                                    next(self._call_ids),
+                                    Message.value_of(b"INCREMENT"),
+                                    type=write_request_type())
+            try:
+                reply = await client.send_request(server.address, req)
+            except (RaftException, asyncio.TimeoutError):
+                reply = None
+            if reply is not None and reply.success:
+                self._leader_hint[gid] = server
+                return reply
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"write to {gid} kept failing")
+            exc = reply.exception if reply is not None else None
+            if isinstance(exc, NotLeaderException) \
+                    and exc.suggested_leader is not None:
+                by_id = {s.peer_id: s for s in self.servers}
+                server = by_id.get(exc.suggested_leader.id, server)
+            elif isinstance(exc, LeaderNotReadyException):
+                await asyncio.sleep(0.01)
+            else:
+                idx = self.servers.index(server)
+                server = self.servers[(idx + 1) % len(self.servers)]
+                await asyncio.sleep(0.01)
+
+    async def run_load(self, writes_per_group: int,
+                       concurrency: int = 256) -> dict:
+        """Drive writes_per_group sequential writes per group, groups
+        concurrent under a global in-flight bound; returns throughput and
+        latency percentiles."""
+        client = self.factory.new_client_transport()
+        sem = asyncio.Semaphore(concurrency)
+        latencies: list[float] = []
+
+        async def group_load(g: RaftGroup):
+            client_id = ClientId.random_id()
+            for _ in range(writes_per_group):
+                async with sem:
+                    t0 = time.monotonic()
+                    await self._write(client, client_id, g.group_id)
+                    latencies.append(time.monotonic() - t0)
+
+        t_start = time.monotonic()
+        await asyncio.gather(*(group_load(g) for g in self.groups))
+        elapsed = time.monotonic() - t_start
+
+        latencies.sort()
+        n = len(latencies)
+        total = self.num_groups * writes_per_group
+        return {
+            "commits": total,
+            "elapsed_s": round(elapsed, 3),
+            "commits_per_sec": round(total / elapsed, 1),
+            "p50_ms": round(latencies[n // 2] * 1e3, 2),
+            "p99_ms": round(latencies[min(n - 1, (n * 99) // 100)] * 1e3, 2),
+            "election_convergence_s": round(self.election_convergence_s, 2),
+        }
+
+
+async def run_bench(num_groups: int, writes_per_group: int,
+                    batched: bool = True, concurrency: int = 256,
+                    warmup_writes: int = 1) -> dict:
+    """One ladder rung: build the trio, elect, warm up, measure, tear down."""
+    cluster = BenchCluster(num_groups, batched=batched)
+    try:
+        await cluster.start()
+        if warmup_writes:
+            await cluster.run_load(warmup_writes, concurrency)
+        result = await cluster.run_load(writes_per_group, concurrency)
+        engines = [s.engine for s in cluster.servers]
+        result["batched_dispatches"] = sum(
+            e.metrics["batched_dispatches"] for e in engines)
+        result["engine_ticks"] = sum(e.metrics["ticks"] for e in engines)
+        result["groups"] = num_groups
+        result["mode"] = "batched" if batched else "scalar"
+        return result
+    finally:
+        await cluster.close()

@@ -1,0 +1,132 @@
+"""StateMachine lifecycle notifications (reference StateMachine.java:237-283,
+tested there by TestRaftServerSlownessDetection and
+TestRaftServerNoLeaderTimeout): follower slowness, extended no-leader,
+not-leader pending drain, and server shutdown all reach the state machine.
+"""
+
+import asyncio
+
+from minicluster import MiniCluster, fast_properties, run_with_new_cluster
+from ratis_tpu.conf import RaftServerConfigKeys
+from ratis_tpu.models.counter import CounterStateMachine
+
+
+class EventRecordingSM(CounterStateMachine):
+    def __init__(self) -> None:
+        super().__init__()
+        self.events: list[tuple] = []
+
+    async def notify_follower_slowness(self, role_info, slow_peer) -> None:
+        self.events.append(("slowness", slow_peer.id if slow_peer else None))
+
+    async def notify_extended_no_leader(self, role_info) -> None:
+        self.events.append(("no_leader", role_info["role"]))
+
+    async def notify_not_leader(self, pending_requests) -> None:
+        self.events.append(("not_leader", list(pending_requests)))
+
+    async def notify_server_shutdown(self, role_info, all_groups) -> None:
+        self.events.append(("shutdown", all_groups))
+
+
+def _props(**overrides):
+    p = fast_properties()
+    for k, v in overrides.items():
+        p.set(k, v)
+    return p
+
+
+def test_follower_slowness_notification():
+    """A follower that stops responding for Rpc.slowness_timeout triggers
+    notify_follower_slowness on the leader's SM, once per period
+    (TestRaftServerSlownessDetection analog)."""
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        assert (await cluster.send_write()).success
+        slow = next(d for d in cluster.divisions() if not d.is_leader())
+        sid = slow.member_id.peer_id
+        cluster.network.block(leader.member_id.peer_id, sid)
+        deadline = asyncio.get_event_loop().time() + 5.0
+        sm = leader.state_machine
+        while asyncio.get_event_loop().time() < deadline:
+            if any(e[0] == "slowness" and e[1] == sid for e in sm.events):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(f"no slowness event; got {sm.events}")
+        cluster.network.unblock_all()
+
+    run_with_new_cluster(
+        3, body, sm_factory=EventRecordingSM,
+        properties=_props(**{
+            RaftServerConfigKeys.Rpc.SLOWNESS_TIMEOUT_KEY: "400ms"}))
+
+
+def test_extended_no_leader_notification():
+    """A follower that cannot find a leader past
+    Notification.no_leader_timeout notifies its SM
+    (TestRaftServerNoLeaderTimeout analog)."""
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        victim = next(d for d in cluster.divisions() if not d.is_leader())
+        vid = victim.member_id.peer_id
+        # full isolation: sees no leader, elections can't win
+        others = [d.member_id.peer_id for d in cluster.divisions()
+                  if d.member_id.peer_id != vid]
+        cluster.network.partition([vid], others)
+        sm = victim.state_machine
+        deadline = asyncio.get_event_loop().time() + 8.0
+        while asyncio.get_event_loop().time() < deadline:
+            if any(e[0] == "no_leader" for e in sm.events):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(f"no no_leader event; got {sm.events}")
+        cluster.network.unblock_all()
+
+    run_with_new_cluster(
+        3, body, sm_factory=EventRecordingSM,
+        properties=_props(**{
+            RaftServerConfigKeys.Notification.NO_LEADER_TIMEOUT_KEY: "500ms"}))
+
+
+def test_not_leader_drains_pending_to_sm():
+    """A leader that steps down with uncommittable pending writes hands them
+    to notify_not_leader before failing their futures."""
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        lid = leader.member_id.peer_id
+        others = [d.member_id.peer_id for d in cluster.divisions()
+                  if d.member_id.peer_id != lid]
+        cluster.network.partition([lid], others)
+        # this write reaches the isolated leader and pends forever there
+        write = asyncio.create_task(cluster.send(
+            b"INCREMENT", server_id=lid, timeout=20.0))
+        sm = leader.state_machine
+        deadline = asyncio.get_event_loop().time() + 8.0
+        while asyncio.get_event_loop().time() < deadline:
+            if any(e[0] == "not_leader" and e[1] for e in sm.events):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(f"no not_leader event; got "
+                                 f"{[e[0] for e in sm.events]}")
+        cluster.network.unblock_all()
+        reply = await write  # client retries to the majority-side leader
+        assert reply.success
+
+    run_with_new_cluster(3, body, sm_factory=EventRecordingSM)
+
+
+def test_server_shutdown_notification():
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        sms = [d.state_machine for d in cluster.divisions()]
+        await cluster.close()
+        for sm in sms:
+            assert ("shutdown", True) in sm.events, sm.events
+
+    run_with_new_cluster(3, body, sm_factory=EventRecordingSM)

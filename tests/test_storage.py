@@ -323,3 +323,96 @@ class TestDecoupledFlush:
             await log.close()
 
         run(body())
+
+
+class TestCacheEviction:
+    """SegmentedRaftLogCache parity (SegmentedRaftLogCache.java): closed
+    segments past the cache budget drop their payloads once applied; reads
+    below the eviction line come back through the file."""
+
+    def test_evict_and_read_through(self, tmp_path):
+        async def body():
+            log = SegmentedRaftLog("t", tmp_path, worker=LogWorker("we"),
+                                   segment_size_max=256,
+                                   cache_segments_max=2)
+            await log.open()
+            for i in range(40):
+                await log.append_entry(entry(1, i, size=32))
+            closed = [s for s in log._segments if not s.is_open]
+            assert len(closed) > 3  # several closed segments exist
+            assert log.evict_cache(applied_index=-1) == 0  # nothing applied
+            evicted = log.evict_cache(applied_index=39)
+            assert evicted == len(closed) - 2
+            assert log.cached_segments == 2
+            # metadata stays resident: term/prev checks never fault
+            assert log.get_term_index(1) == TermIndex(1, 1)
+            # payload reads fault the segment in from disk
+            e = log.get(1)
+            assert e is not None and e.index == 1
+            assert log.metrics.cache_miss_count.count >= 1
+            # sequential scan (a lagging follower's catch-up batch) is served
+            # from the single-slot read-through cache after the first miss
+            first_seg = next(s for s in log._segments if not s.cached)
+            entries = log.get_entries(first_seg.start, first_seg.end + 1)
+            assert [e.index for e in entries] == list(
+                range(first_seg.start, first_seg.end + 1))
+            await log.close()
+
+        run(body())
+
+    def test_truncate_into_evicted_segment(self, tmp_path):
+        async def body():
+            log = SegmentedRaftLog("t", tmp_path, worker=LogWorker("wt"),
+                                   segment_size_max=256,
+                                   cache_segments_max=0)
+            await log.open()
+            for i in range(40):
+                await log.append_entry(entry(1, i, size=32))
+            log.evict_cache(applied_index=39)
+            assert log.cached_segments == 0
+            # truncate into an evicted segment: reloads, rewrites, stays open
+            target = next(s for s in log._segments if not s.is_open)
+            cut = target.start + 1
+            await log.truncate(cut)
+            assert log.next_index == cut
+            for i in range(cut, cut + 3):
+                await log.append_entry(entry(2, i, size=32))
+            assert log.get(cut).term == 2
+            assert log.get(cut - 1).term == 1
+            await log.close()
+
+        run(body())
+
+    def test_lagging_follower_served_from_disk(self, tmp_path):
+        """Cluster-level: a killed follower catches up from a leader whose
+        log entries were evicted from memory (reads come through the file,
+        not the snapshot path)."""
+
+        async def body(cluster: MiniCluster):
+            leader = await cluster.wait_for_leader()
+            follower = next(d for d in cluster.divisions()
+                            if not d.is_leader())
+            fid = follower.member_id.peer_id
+            await cluster.kill_server(fid)
+            for _ in range(40):
+                assert (await cluster.send_write()).success
+            for d in cluster.divisions():
+                d.state.log.evict_cache(d.applied_index)
+                assert d.state.log.cached_segments <= 1
+            await cluster.restart_server(fid)
+            new_div = cluster.servers[fid].divisions[cluster.group.group_id]
+            last = (await cluster.wait_for_leader()).state.log \
+                .get_last_committed_index()
+            await cluster.wait_applied(last, divisions=[new_div],
+                                       timeout=20.0)
+            assert new_div.state_machine.counter == 40
+
+        from minicluster import run_with_new_cluster
+        from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys
+        from tests.minicluster import fast_properties
+        p = fast_properties()
+        RaftServerConfigKeys.Log.set_use_memory(p, False)
+        p.set(RaftServerConfigKeys.Log.SEGMENT_SIZE_MAX_KEY, "512")
+        p.set(RaftServerConfigKeys.Log.SEGMENT_CACHE_NUM_MAX_KEY, "1")
+        run_with_new_cluster(3, body, properties=p,
+                             storage_root=str(tmp_path))
