@@ -190,15 +190,19 @@ class RaftClient:
                                       type_case: TypeCase,
                                       server_id: Optional[RaftPeerId] = None,
                                       timeout_ms: float = 3000.0,
-                                      group_id: Optional[RaftGroupId] = None
+                                      group_id: Optional[RaftGroupId] = None,
+                                      ordering: Optional[tuple] = None
                                       ) -> RaftClientReply:
         """The failover loop (reference BlockingImpl.sendRequestWithRetry +
-        RaftClientImpl.handleIOException)."""
+        RaftClientImpl.handleIOException).  ``ordering`` is the OrderedApi's
+        (SlidingWindowClient, seqNum): each attempt carries the seqNum and a
+        per-attempt recomputed isFirst flag, and failover resets the window's
+        first marker (reference OrderedAsync.java:59 resetSlidingWindow)."""
         req = self._new_request(message, type_case, server_id, timeout_ms,
                                 group_id)
         sticky = server_id is not None  # explicit target: no failover
         try:
-            return await self._retry_loop(req, sticky)
+            return await self._retry_loop(req, sticky, ordering)
         except BaseException:
             # the piggybacked ids never reached a server that replied OK:
             # requeue them for the next request (reference RepliedCallIds
@@ -206,8 +210,10 @@ class RaftClient:
             self._replied_call_ids.update(req.replied_call_ids)
             raise
 
-    async def _retry_loop(self, req: RaftClientRequest, sticky: bool
+    async def _retry_loop(self, req: RaftClientRequest, sticky: bool,
+                          ordering: Optional[tuple] = None
                           ) -> RaftClientReply:
+        window, seq = ordering if ordering is not None else (None, -1)
         attempt = 0
         while True:
             attempt += 1
@@ -227,6 +233,9 @@ class RaftClient:
                     attempt_req = RaftClientRequest(
                         req.client_id, target, req.group_id, req.call_id,
                         req.message, type=req.type, timeout_ms=req.timeout_ms,
+                        slider_seq_num=seq,
+                        slider_first=(window.is_first(seq)
+                                      if window is not None else False),
                         replied_call_ids=req.replied_call_ids)
                     reply = await self.transport.send_request(
                         address, attempt_req)
@@ -235,6 +244,8 @@ class RaftClient:
                     cause = e
                     if not sticky:
                         self._leader_id = self._next_peer(target)
+                    if window is not None:
+                        window.reset_first_seq()
 
             if reply is not None:
                 if reply.success:
@@ -246,6 +257,10 @@ class RaftClient:
                 if nle is not None and not sticky:
                     self._on_not_leader(nle)
                     cause = nle
+                    if window is not None:
+                        # new server, new reorder window: the lowest
+                        # outstanding seq becomes "first" again
+                        window.reset_first_seq()
                 elif isinstance(exc, _RETRY_SAME):
                     cause = exc
                 else:
@@ -322,20 +337,30 @@ class RaftClientBuilder:
 
 
 class OrderedApi:
-    """Writes/reads with client-side ordering (reference BlockingApi +
-    OrderedAsync: seqNum-ordered pipeline with bounded outstanding window)."""
+    """Writes with seqNum-ordered pipelining (reference OrderedAsync.java:59):
+    up to ``max_outstanding`` concurrent sends, each stamped with a
+    consecutive seqNum from a SlidingWindowClient; the leader's per-client
+    reorder window (division._write_ordered) appends them to the raft log in
+    seqNum order even when the transport delivers them out of order, so two
+    concurrent ``send()``s always commit in submission order."""
 
     def __init__(self, client: RaftClient, max_outstanding: int = 128):
+        from ratis_tpu.util.sliding_window import SlidingWindowClient
         self.client = client
         self._sem = asyncio.Semaphore(max_outstanding)
-        self._seq = itertools.count(0)
+        self._window = SlidingWindowClient(name=str(client.client_id))
 
     async def send(self, message: "Message | bytes") -> RaftClientReply:
-        """Ordered write (reference BlockingApi.send)."""
+        """Ordered write (reference OrderedAsync.send)."""
         msg = message if isinstance(message, Message) else Message(message)
         async with self._sem:
-            return await self.client.send_request_with_retry(
-                msg, write_request_type())
+            seq = self._window.submit_new_request(lambda s: s)
+            try:
+                return await self.client.send_request_with_retry(
+                    msg, write_request_type(),
+                    ordering=(self._window, seq))
+            finally:
+                self._window.receive_reply(seq)
 
     async def send_read_only(self, message: "Message | bytes",
                              nonlinearizable: bool = False,

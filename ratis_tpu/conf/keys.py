@@ -314,6 +314,34 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.LeaderElection.LEADER_STEP_DOWN_WAIT_TIME_KEY,
                 RaftServerConfigKeys.LeaderElection.LEADER_STEP_DOWN_WAIT_TIME_DEFAULT)
 
+    class PauseMonitor:
+        """Event-loop pause monitor (reference JvmPauseMonitor.java:38)."""
+
+        ENABLED_KEY = "raft.server.pause.monitor.enabled"
+        ENABLED_DEFAULT = True
+        INTERVAL_KEY = "raft.server.pause.monitor.interval"
+        INTERVAL_DEFAULT = TimeDuration.millis(100)
+        WARN_KEY = "raft.server.pause.monitor.warn.threshold"
+        WARN_DEFAULT = TimeDuration.millis(500)
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.PauseMonitor.ENABLED_KEY,
+                RaftServerConfigKeys.PauseMonitor.ENABLED_DEFAULT)
+
+        @staticmethod
+        def interval(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.PauseMonitor.INTERVAL_KEY,
+                RaftServerConfigKeys.PauseMonitor.INTERVAL_DEFAULT)
+
+        @staticmethod
+        def warn_threshold(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.PauseMonitor.WARN_KEY,
+                RaftServerConfigKeys.PauseMonitor.WARN_DEFAULT)
+
     class Notification:
         NO_LEADER_TIMEOUT_KEY = "raft.server.notification.no-leader.timeout"
         NO_LEADER_TIMEOUT_DEFAULT = TimeDuration.valueOf("60s")
@@ -353,6 +381,49 @@ class RaftServerConfigKeys:
         def max_peers(p: RaftProperties) -> int:
             return p.get_int(RaftServerConfigKeys.Engine.MAX_PEERS_KEY,
                              RaftServerConfigKeys.Engine.MAX_PEERS_DEFAULT)
+
+
+class GrpcConfigKeys:
+    """gRPC transport keys (reference GrpcConfigKeys, ratis-grpc/.../
+    GrpcConfigKeys.java; TLS block maps GrpcTlsConfig)."""
+
+    PREFIX = "raft.grpc"
+
+    class Tls:
+        ENABLED_KEY = "raft.grpc.tls.enabled"
+        ENABLED_DEFAULT = False
+        CERT_CHAIN_KEY = "raft.grpc.tls.cert.chain.path"
+        PRIVATE_KEY_KEY = "raft.grpc.tls.private.key.path"
+        TRUST_ROOT_KEY = "raft.grpc.tls.trust.root.path"
+        MUTUAL_AUTH_KEY = "raft.grpc.tls.mutual.auth.enabled"
+        MUTUAL_AUTH_DEFAULT = False
+        NAME_OVERRIDE_KEY = "raft.grpc.tls.target.name.override"
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(GrpcConfigKeys.Tls.ENABLED_KEY,
+                                 GrpcConfigKeys.Tls.ENABLED_DEFAULT)
+
+        @staticmethod
+        def cert_chain(p: RaftProperties):
+            return p.get(GrpcConfigKeys.Tls.CERT_CHAIN_KEY)
+
+        @staticmethod
+        def private_key(p: RaftProperties):
+            return p.get(GrpcConfigKeys.Tls.PRIVATE_KEY_KEY)
+
+        @staticmethod
+        def trust_root(p: RaftProperties):
+            return p.get(GrpcConfigKeys.Tls.TRUST_ROOT_KEY)
+
+        @staticmethod
+        def mutual_auth(p: RaftProperties) -> bool:
+            return p.get_boolean(GrpcConfigKeys.Tls.MUTUAL_AUTH_KEY,
+                                 GrpcConfigKeys.Tls.MUTUAL_AUTH_DEFAULT)
+
+        @staticmethod
+        def name_override(p: RaftProperties):
+            return p.get(GrpcConfigKeys.Tls.NAME_OVERRIDE_KEY)
 
 
 class RaftClientConfigKeys:

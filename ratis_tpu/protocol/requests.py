@@ -114,6 +114,10 @@ class RaftClientRequest:
     message: Message = Message.EMPTY
     type: TypeCase = dataclasses.field(default_factory=write_request_type)
     slider_seq_num: int = -1  # ordered-async sliding window sequence number
+    # First request of a (possibly post-failover) window: tells the server to
+    # (re)base its per-client reorder window at this seqNum (reference
+    # SlidingWindow.Request.isFirstRequest, SlidingWindow.java:277).
+    slider_first: bool = False
     timeout_ms: float = 3000.0
     # Piggybacked already-replied call ids for server retry-cache GC
     # (reference RaftClientImpl.RepliedCallIds, RaftClientImpl.java:128).
@@ -134,6 +138,7 @@ class RaftClientRequest:
             "cid": self.client_id.to_bytes(), "sid": self.server_id.id,
             "gid": self.group_id.to_bytes(), "call": self.call_id,
             "msg": self.message.content, "seq": self.slider_seq_num,
+            "sf": self.slider_first,
             "to": self.timeout_ms, "rcids": list(self.replied_call_ids),
             "t": {"t": int(t.type), "rnl": t.read_nonlinearizable,
                   "raw": t.read_after_write_consistent,
@@ -150,7 +155,9 @@ class RaftClientRequest:
             server_id=RaftPeerId.value_of(d["sid"]),
             group_id=RaftGroupId.value_of(d["gid"]),
             call_id=d["call"], message=Message(d["msg"]),
-            slider_seq_num=d.get("seq", -1), timeout_ms=d.get("to", 3000.0),
+            slider_seq_num=d.get("seq", -1),
+            slider_first=d.get("sf", False),
+            timeout_ms=d.get("to", 3000.0),
             replied_call_ids=tuple(d.get("rcids", ())),
             type=TypeCase(RequestType(t["t"]), read_nonlinearizable=t["rnl"],
                           read_after_write_consistent=t.get("raw", False),

@@ -157,6 +157,9 @@ class Division:
             RaftServerConfigKeys.Notification.no_leader_timeout(p).seconds
         self._last_no_leader_notify_s = 0.0
         self._started_at_s = 0.0
+        # per-client ordered-async reorder windows (leader only; see
+        # _write_ordered)
+        self._client_windows: dict = {}
 
         # admin state
         self.pending_reconf = None  # Optional[admin.PendingReconf]
@@ -393,6 +396,8 @@ class Division:
             self.election.stop()
         if self._election_task is not None:
             self._election_task.cancel()
+        self._drain_client_windows(
+            RaftException(f"{self.member_id} is closing"))
         for t in list(self._bg_tasks):
             t.cancel()
         self._bg_tasks.clear()
@@ -555,6 +560,7 @@ class Division:
                                      self.state.configuration.all_peers())
             await ctx.stop(nle)
             self.watch_requests.drain(nle)
+            self._drain_client_windows(nle)
             LOG.info("%s stepped down (%s)", self.member_id, reason)
         if old_role == RaftPeerRole.CANDIDATE and self.election is not None:
             self.election.stop()
@@ -1006,6 +1012,8 @@ class Division:
                                            req.replied_call_ids)
         t = req.type.type
         if t == RequestType.WRITE:
+            if req.slider_seq_num >= 0:
+                return await self._write_ordered(req)
             return await self._write_async(req)
         if t == RequestType.READ:
             return await self._read_async(req)
@@ -1050,7 +1058,79 @@ class Division:
                     req, LeaderNotReadyException(self.member_id))
         return None
 
-    async def _write_async(self, req: RaftClientRequest) -> RaftClientReply:
+    async def _write_ordered(self, req: RaftClientRequest) -> RaftClientReply:
+        """Ordered-async server side (reference
+        GrpcClientProtocolService.java:151 + SlidingWindow.Server): requests
+        from one client are released to the log-append path strictly in
+        seqNum order; the window advances as soon as a request is APPENDED
+        (not committed), so ordering costs no pipelining."""
+        err = self._check_leader(req)
+        if err is not None:
+            return err  # fast-fail: only a live leader parks requests
+        cid = req.client_id.to_bytes()
+        win = self._client_windows.get(cid)
+        if win is None:
+            from ratis_tpu.util.sliding_window import SlidingWindowServer
+            win = SlidingWindowServer(self._ordered_submit, name=str(req.client_id))
+            self._client_windows[cid] = win
+        win.last_used = asyncio.get_event_loop().time()
+        self._sweep_client_windows()
+        fut = asyncio.get_event_loop().create_future()
+        accepted = await win.receive(req.slider_seq_num, req.slider_first,
+                                     (req, fut))
+        if not accepted:
+            # duplicate of an already-released seq: the retry cache answers
+            # it (same call_id as the original execution)
+            return await self._write_async(req)
+        return await fut
+
+    def _sweep_client_windows(self) -> None:
+        """Idle-window GC: the reference ties window lifetime to the client
+        stream; with per-request transports we expire instead."""
+        if len(self._client_windows) <= 256:
+            return
+        now = asyncio.get_event_loop().time()
+        for cid, win in list(self._client_windows.items()):
+            if win.pending_count() == 0 \
+                    and now - getattr(win, "last_used", 0.0) > 120.0:
+                del self._client_windows[cid]
+
+    async def _ordered_submit(self, item) -> None:
+        """SlidingWindowServer process callback: run the write, but return
+        (releasing the next seqNum) as soon as this request has been
+        appended to the log — commit/apply completes the reply later."""
+        req, fut = item
+        submitted = asyncio.get_event_loop().create_future()
+
+        def on_submitted() -> None:
+            if not submitted.done():
+                submitted.set_result(None)
+
+        async def run() -> None:
+            try:
+                reply = await self._write_async(req, on_submitted=on_submitted)
+                if not fut.done():
+                    fut.set_result(reply)
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)
+            finally:
+                on_submitted()
+
+        self._spawn_bg(run())
+        await submitted
+
+    def _drain_client_windows(self, exception: Exception) -> None:
+        """Step-down/close: fail requests still parked in reorder windows."""
+        for win in self._client_windows.values():
+            for req, fut in win.drain_parked():
+                if not fut.done():
+                    fut.set_result(
+                        RaftClientReply.failure_reply(req, exception))
+        self._client_windows.clear()
+
+    async def _write_async(self, req: RaftClientRequest,
+                           on_submitted=None) -> RaftClientReply:
         err = self._check_leader(req)
         if err is not None:
             return err
@@ -1067,6 +1147,8 @@ class Division:
                 self.metrics.retry_cache_miss.inc()
                 break
             self.metrics.retry_cache_hit.inc()
+            if on_submitted is not None:
+                on_submitted()  # the original attempt already appended it
             try:
                 return await asyncio.shield(cache_entry.future)
             except asyncio.CancelledError:
@@ -1075,7 +1157,7 @@ class Division:
 
         with self.metrics.write_timer.time():
             try:
-                reply = await self._write_impl(req)
+                reply = await self._write_impl(req, on_submitted)
             except asyncio.CancelledError:
                 cache_entry.fail()
                 raise
@@ -1098,7 +1180,8 @@ class Division:
             cache_entry.fail()  # let a retry re-execute
         return reply
 
-    async def _write_impl(self, req: RaftClientRequest) -> RaftClientReply:
+    async def _write_impl(self, req: RaftClientRequest,
+                          on_submitted=None) -> RaftClientReply:
         await injection.execute(injection.APPEND_TRANSACTION, self.member_id,
                                 req.client_id)
         try:
@@ -1133,6 +1216,8 @@ class Division:
         await log.append_entry(entry, wait_flush=False)
         self._engine_update_flush()
         self.leader_ctx.notify_appenders()
+        if on_submitted is not None:
+            on_submitted()  # appended: the ordered window may release the next
         return await pending.future
 
     async def _read_async(self, req: RaftClientRequest) -> RaftClientReply:

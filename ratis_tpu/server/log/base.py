@@ -117,6 +117,11 @@ class RaftLog:
         out: list[LogEntry] = []
         total = 0
         for i in range(start, min(end, self.next_index)):
+            if out and not self.is_resident(i):
+                # batch crossed into an evicted segment: stop here rather
+                # than fault multi-MB of entries in synchronously; the
+                # caller's next round prefaults off-loop
+                break
             e = self.get(i)
             if e is None:
                 break

@@ -262,9 +262,14 @@ class SegmentedRaftLog(RaftLog):
         self.cache_segments_max = cache_segments_max
         self._segments: list[_Segment] = []
         # read-through cache: seg.start -> entries, tiny LRU (a couple of
-        # lagging followers scanning different segments shouldn't thrash)
+        # lagging followers scanning different segments shouldn't thrash).
+        # Guarded by a threading lock: prefault() runs in to_thread workers
+        # concurrently with event-loop readers.
         self._rt_cache: "dict[int, list[LogEntry]]" = {}
         self._rt_cache_max = 3
+        self._rt_version = 0  # bumped on truncate/purge/snapshot invalidation
+        import threading
+        self._rt_lock = threading.Lock()
         self._open_file = None
         self._flush_index = INVALID_LOG_INDEX
         self._below_start: Optional[TermIndex] = None
@@ -368,16 +373,27 @@ class SegmentedRaftLog(RaftLog):
         return self._below_start
 
     def _fault_in(self, seg: _Segment) -> list[LogEntry]:
-        entries = self._rt_cache.get(seg.start)
+        with self._rt_lock:
+            entries = self._rt_cache.get(seg.start)
+            version = self._rt_version
         if entries is None:
             self.metrics.cache_miss_count.inc()
-            entries = seg.load()
-            self._rt_cache[seg.start] = entries
-            while len(self._rt_cache) > self._rt_cache_max:
-                self._rt_cache.pop(next(iter(self._rt_cache)))
+            entries = seg.load()  # file IO outside the lock
+            with self._rt_lock:
+                if self._rt_version == version:
+                    # don't cache across an invalidation (a truncate may
+                    # have rewritten the file while we were reading it)
+                    self._rt_cache[seg.start] = entries
+                    while len(self._rt_cache) > self._rt_cache_max:
+                        self._rt_cache.pop(next(iter(self._rt_cache)))
         else:
             self.metrics.cache_hit_count.inc()
         return entries
+
+    def _invalidate_rt_cache(self) -> None:
+        with self._rt_lock:
+            self._rt_version += 1
+            self._rt_cache.clear()
 
     def _read_through(self, seg: _Segment, index: int) -> Optional[LogEntry]:
         """Serve an evicted segment from its file (one whole-segment read,
@@ -527,7 +543,7 @@ class SegmentedRaftLog(RaftLog):
 
     async def truncate(self, index: int) -> None:
         self.metrics.truncate_count.inc()
-        self._rt_cache.clear()
+        self._invalidate_rt_cache()
         await self.worker.drain()
         while self._segments and self._segments[-1].start >= index:
             seg = self._segments.pop()
@@ -567,7 +583,7 @@ class SegmentedRaftLog(RaftLog):
         reference purges at segment granularity too (purgeImpl)."""
         ti = self.get_term_index(index)
         self.metrics.purge_count.inc()
-        self._rt_cache.clear()
+        self._invalidate_rt_cache()
         # Roll the open segment first when the snapshot fully covers it, so
         # purge can reclaim it too (otherwise a single-open-segment log would
         # never shrink after snapshotting).
@@ -588,7 +604,7 @@ class SegmentedRaftLog(RaftLog):
 
     def set_snapshot_boundary(self, ti: TermIndex) -> None:
         """After snapshot install: discard the local log below/at ti."""
-        self._rt_cache.clear()
+        self._invalidate_rt_cache()
         for seg in self._segments:
             seg.path.unlink(missing_ok=True)
         self._segments.clear()

@@ -67,6 +67,7 @@ class RaftServer:
                 RaftServerConfigKeys.Engine.SCALAR_FALLBACK_THRESHOLD_DEFAULT),
             leadership_timeout_ms=int(
                 RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2))
+        self.pause_monitor = None  # started in start() when enabled
         # peer id -> network address, fed from every conf the server sees
         # (division conf syncs, staging, group adds); the resolver transports
         # dial by (reference PeerProxyMap's address source).
@@ -106,6 +107,11 @@ class RaftServer:
     async def start(self) -> None:
         self.life_cycle.transition(LifeCycleState.STARTING)
         await self.engine.start()
+        from ratis_tpu.conf.keys import RaftServerConfigKeys as _K
+        if _K.PauseMonitor.enabled(self.properties):
+            from ratis_tpu.server.pause_monitor import PauseMonitor
+            self.pause_monitor = PauseMonitor(self)
+            self.pause_monitor.start()
         # Boot scan: recover every group found on disk
         # (reference RaftServerProxy.initGroups:257-288).
         root = self._storage_root()
@@ -140,6 +146,9 @@ class RaftServer:
             if not self.life_cycle.compare_and_transition(
                     LifeCycleState.NEW, LifeCycleState.CLOSING):
                 return
+        if self.pause_monitor is not None:
+            await self.pause_monitor.close()
+            self.pause_monitor = None
         await self.transport.close()
         if self.datastream is not None:
             await self.datastream.close()

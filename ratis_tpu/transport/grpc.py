@@ -21,14 +21,17 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import pathlib
 from typing import Callable, Optional
 
 import grpc
 import grpc.aio
+import msgpack
 
 from ratis_tpu.protocol.exceptions import RaftException, TimeoutIOException
 from ratis_tpu.protocol.ids import RaftPeerId
-from ratis_tpu.protocol.raftrpc import decode_rpc, encode_rpc
+from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, decode_rpc,
+                                        encode_rpc)
 from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
 from ratis_tpu.transport.base import (ClientRequestHandler, ClientTransport,
                                       ServerRpcHandler, ServerTransport,
@@ -39,7 +42,68 @@ LOG = logging.getLogger(__name__)
 SERVER_SERVICE = "ratis_tpu.RaftServerProtocol"
 CLIENT_SERVICE = "ratis_tpu.RaftClientProtocol"
 _RPC_METHOD = f"/{SERVER_SERVICE}/rpc"
+_APPEND_STREAM_METHOD = f"/{SERVER_SERVICE}/appendStream"
 _REQUEST_METHOD = f"/{CLIENT_SERVICE}/request"
+
+# append-stream envelope status codes
+_ST_OK = 0
+_ST_RAFT_ERROR = 1
+_ST_INTERNAL = 2
+
+
+class GrpcTlsConfig:
+    """TLS parameters (reference GrpcTlsConfig, ratis-grpc/.../GrpcTlsConfig):
+    cert chain + private key for the server side, an optional trust root for
+    verifying peers/servers, optional mutual auth."""
+
+    def __init__(self, cert_chain_path: Optional[str] = None,
+                 private_key_path: Optional[str] = None,
+                 trust_root_path: Optional[str] = None,
+                 mutual_auth: bool = False,
+                 target_name_override: Optional[str] = None):
+        self.cert_chain_path = cert_chain_path
+        self.private_key_path = private_key_path
+        self.trust_root_path = trust_root_path
+        self.mutual_auth = mutual_auth
+        # test/dev certs are rarely issued for raw IPs; this maps to
+        # grpc.ssl_target_name_override
+        self.target_name_override = target_name_override
+
+    @staticmethod
+    def from_properties(p) -> Optional["GrpcTlsConfig"]:
+        from ratis_tpu.conf.keys import GrpcConfigKeys
+        if p is None or not GrpcConfigKeys.Tls.enabled(p):
+            return None
+        return GrpcTlsConfig(
+            cert_chain_path=GrpcConfigKeys.Tls.cert_chain(p),
+            private_key_path=GrpcConfigKeys.Tls.private_key(p),
+            trust_root_path=GrpcConfigKeys.Tls.trust_root(p),
+            mutual_auth=GrpcConfigKeys.Tls.mutual_auth(p),
+            target_name_override=GrpcConfigKeys.Tls.name_override(p))
+
+    def _read(self, path: Optional[str]) -> Optional[bytes]:
+        return pathlib.Path(path).read_bytes() if path else None
+
+    def server_credentials(self) -> grpc.ServerCredentials:
+        return grpc.ssl_server_credentials(
+            [(self._read(self.private_key_path),
+              self._read(self.cert_chain_path))],
+            root_certificates=self._read(self.trust_root_path),
+            require_client_auth=self.mutual_auth)
+
+    def channel_credentials(self) -> grpc.ChannelCredentials:
+        return grpc.ssl_channel_credentials(
+            root_certificates=self._read(self.trust_root_path),
+            private_key=(self._read(self.private_key_path)
+                         if self.mutual_auth else None),
+            certificate_chain=(self._read(self.cert_chain_path)
+                               if self.mutual_auth else None))
+
+    def channel_options(self) -> list:
+        if self.target_name_override:
+            return [("grpc.ssl_target_name_override",
+                     self.target_name_override)]
+        return []
 
 # Generous bounds: appenders batch up to the configured buffer byte limit,
 # snapshot chunks up to snapshot.chunk.size.max (16MB default).
@@ -58,22 +122,135 @@ _TRANSIENT_CODES = frozenset((grpc.StatusCode.UNAVAILABLE,
 
 
 class _ChannelPool:
-    """address -> aio channel cache (reference PeerProxyMap)."""
+    """address -> aio channel cache with cached multicallables
+    (reference PeerProxyMap; building a fresh multicallable per call was
+    measurable overhead on the append hot path)."""
 
-    def __init__(self):
+    def __init__(self, tls: Optional[GrpcTlsConfig] = None):
         self._channels: dict[str, grpc.aio.Channel] = {}
+        self._unary: dict[tuple[str, str], object] = {}
+        self._stream: dict[tuple[str, str], object] = {}
+        self._tls = tls
 
     def get(self, address: str) -> grpc.aio.Channel:
         ch = self._channels.get(address)
         if ch is None:
-            ch = grpc.aio.insecure_channel(address, options=_CHANNEL_OPTIONS)
+            if self._tls is not None:
+                ch = grpc.aio.secure_channel(
+                    address, self._tls.channel_credentials(),
+                    options=_CHANNEL_OPTIONS + self._tls.channel_options())
+            else:
+                ch = grpc.aio.insecure_channel(address,
+                                               options=_CHANNEL_OPTIONS)
             self._channels[address] = ch
         return ch
 
+    def unary(self, address: str, method: str):
+        key = (address, method)
+        call = self._unary.get(key)
+        if call is None:
+            call = self.get(address).unary_unary(
+                method, request_serializer=_identity,
+                response_deserializer=_identity)
+            self._unary[key] = call
+        return call
+
+    def stream(self, address: str, method: str):
+        key = (address, method)
+        call = self._stream.get(key)
+        if call is None:
+            call = self.get(address).stream_stream(
+                method, request_serializer=_identity,
+                response_deserializer=_identity)
+            self._stream[key] = call
+        return call
+
     async def close(self) -> None:
+        self._unary.clear()
+        self._stream.clear()
         for ch in self._channels.values():
             await ch.close()
         self._channels.clear()
+
+
+class _AppendStreamClient:
+    """One ordered bidi stream to a peer carrying entry-bearing
+    AppendEntries (reference GrpcLogAppender's appendEntries stream,
+    GrpcLogAppender.java:343: requests flow in order on one HTTP/2 stream,
+    replies are matched back by a stream-local id).  Heartbeats keep using
+    the unary path — the reference's separate heartbeat channel — so they
+    never queue behind a full window of batches."""
+
+    def __init__(self, multicallable):
+        self._call = multicallable()
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self.closed = False
+        # grpc core rejects overlapping write() ops on one call
+        # (GRPC_CALL_ERROR_TOO_MANY_OPERATIONS): serialize writers.
+        self._write_lock = asyncio.Lock()
+        self._reader = asyncio.create_task(self._read_loop())
+
+    async def send(self, payload: bytes, timeout_s: float) -> bytes:
+        if self.closed:
+            raise TimeoutIOException("append stream closed")
+        call_id = self._next_id
+        self._next_id += 1
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[call_id] = fut
+
+        async def _write_then_wait() -> bytes:
+            async with self._write_lock:
+                await self._call.write(msgpack.packb([call_id, payload]))
+            return await fut
+
+        try:
+            # one deadline over write + reply: a flow-control-blocked write
+            # (frozen peer, full HTTP/2 window) must also time out so the
+            # appender's send slot frees and its window resets
+            return await asyncio.wait_for(_write_then_wait(), timeout_s)
+        finally:
+            self._pending.pop(call_id, None)
+
+    async def _read_loop(self) -> None:
+        try:
+            async for chunk in self._call:
+                call_id, status, payload = msgpack.unpackb(chunk)
+                fut = self._pending.pop(call_id, None)
+                if fut is None or fut.done():
+                    continue
+                if status == _ST_OK:
+                    fut.set_result(payload)
+                elif status == _ST_RAFT_ERROR:
+                    fut.set_exception(RaftException(payload.decode()))
+                else:
+                    fut.set_exception(
+                        TimeoutIOException(payload.decode()))
+        except asyncio.CancelledError:
+            self._fail(ConnectionError("append stream closed"))
+            raise
+        except Exception as e:
+            self._fail(e)
+        else:
+            self._fail(ConnectionError("append stream closed by peer"))
+
+    def _fail(self, exc: Exception) -> None:
+        self.closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    TimeoutIOException(f"append stream error: {exc}"))
+        self._pending.clear()
+
+    async def close(self) -> None:
+        # fail in-flight sends NOW: they must not sit out their full
+        # timeout on a stream we already know is dead
+        self._fail(ConnectionError("append stream closed"))
+        self._reader.cancel()
+        try:
+            await self._reader
+        except (asyncio.CancelledError, Exception):
+            pass
 
 
 class GrpcServerTransport(ServerTransport):
@@ -82,7 +259,8 @@ class GrpcServerTransport(ServerTransport):
                  client_handler: ClientRequestHandler,
                  peer_resolver: Optional[Callable[[RaftPeerId], Optional[str]]]
                  = None,
-                 request_timeout_s: float = 3.0):
+                 request_timeout_s: float = 3.0,
+                 tls: Optional[GrpcTlsConfig] = None):
         self.peer_id = peer_id
         self._address = address
         self._bound_port: Optional[int] = None
@@ -90,8 +268,10 @@ class GrpcServerTransport(ServerTransport):
         self.client_handler = client_handler
         self.peer_resolver = peer_resolver
         self.request_timeout_s = request_timeout_s
+        self.tls = tls
         self._server: Optional[grpc.aio.Server] = None
-        self._pool = _ChannelPool()
+        self._pool = _ChannelPool(tls)
+        self._append_streams: dict[str, _AppendStreamClient] = {}
 
     # ---------------------------------------------------------- service side
 
@@ -119,11 +299,37 @@ class GrpcServerTransport(ServerTransport):
         reply = await self.client_handler(request)
         return reply.to_bytes()
 
+    async def _handle_append_stream(self, request_iterator, context):
+        """Server side of the ordered append stream
+        (GrpcServerProtocolService.java:46 appendEntries stream observer):
+        requests are processed strictly in stream order — one at a time —
+        and each reply carries the request's stream-local id."""
+        async for chunk in request_iterator:
+            try:
+                call_id, payload = msgpack.unpackb(chunk)
+            except Exception as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                    f"undecodable stream chunk: {e}")
+                return
+            try:
+                msg = decode_rpc(payload)
+                reply = await self.server_handler(msg)
+                out = [call_id, _ST_OK, encode_rpc(reply)]
+            except RaftException as e:
+                out = [call_id, _ST_RAFT_ERROR, str(e).encode()]
+            except Exception as e:
+                LOG.exception("%s: append stream rpc failed", self.peer_id)
+                out = [call_id, _ST_INTERNAL, str(e).encode()]
+            yield msgpack.packb(out)
+
     def _generic_handlers(self):
         server_handlers = grpc.method_handlers_generic_handler(
             SERVER_SERVICE,
             {"rpc": grpc.unary_unary_rpc_method_handler(
                 self._handle_rpc, request_deserializer=_identity,
+                response_serializer=_identity),
+             "appendStream": grpc.stream_stream_rpc_method_handler(
+                self._handle_append_stream, request_deserializer=_identity,
                 response_serializer=_identity)})
         client_handlers = grpc.method_handlers_generic_handler(
             CLIENT_SERVICE,
@@ -135,13 +341,21 @@ class GrpcServerTransport(ServerTransport):
     async def start(self) -> None:
         self._server = grpc.aio.server(options=_CHANNEL_OPTIONS)
         self._server.add_generic_rpc_handlers(self._generic_handlers())
-        self._bound_port = self._server.add_insecure_port(self._address)
+        if self.tls is not None:
+            self._bound_port = self._server.add_secure_port(
+                self._address, self.tls.server_credentials())
+        else:
+            self._bound_port = self._server.add_insecure_port(self._address)
         if self._bound_port == 0:
             raise RaftException(f"{self.peer_id}: cannot bind {self._address}")
         await self._server.start()
-        LOG.info("%s: grpc bound %s", self.peer_id, self.address)
+        LOG.info("%s: grpc bound %s%s", self.peer_id, self.address,
+                 " (tls)" if self.tls is not None else "")
 
     async def close(self) -> None:
+        for stream in list(self._append_streams.values()):
+            await stream.close()
+        self._append_streams.clear()
         if self._server is not None:
             await self._server.stop(grace=0.2)
             self._server = None
@@ -157,9 +371,12 @@ class GrpcServerTransport(ServerTransport):
 
     async def send_server_rpc(self, to: RaftPeerId, msg):
         address = self._resolve(to)
-        channel = self._pool.get(address)
-        call = channel.unary_unary(_RPC_METHOD, request_serializer=_identity,
-                                   response_deserializer=_identity)
+        # Entry-bearing appends ride the ordered per-peer bidi stream (FIFO
+        # processing at the follower, matching the pipelined appender's
+        # send order); votes, snapshots and heartbeats stay unary.
+        if isinstance(msg, AppendEntriesRequest) and msg.entries:
+            return await self._send_via_stream(to, address, msg)
+        call = self._pool.unary(address, _RPC_METHOD)
         try:
             reply_bytes = await call(encode_rpc(msg),
                                      timeout=self.request_timeout_s)
@@ -176,6 +393,26 @@ class GrpcServerTransport(ServerTransport):
                 f"{e.details()}") from None
         return decode_rpc(reply_bytes)
 
+    async def _send_via_stream(self, to: RaftPeerId, address: str, msg):
+        stream = self._append_streams.get(address)
+        if stream is None or stream.closed:
+            stream = _AppendStreamClient(
+                lambda: self._pool.stream(address, _APPEND_STREAM_METHOD)())
+            self._append_streams[address] = stream
+        try:
+            reply_bytes = await stream.send(encode_rpc(msg),
+                                            self.request_timeout_s)
+        except (RaftException, TimeoutIOException):
+            raise
+        except (asyncio.TimeoutError, Exception) as e:
+            # broken/stalled stream: drop it so the next send re-dials, and
+            # surface as transient so the appender resets its window
+            self._append_streams.pop(address, None)
+            await stream.close()
+            raise TimeoutIOException(
+                f"{self.peer_id}->{to} append stream: {e}") from None
+        return decode_rpc(reply_bytes)
+
     @property
     def address(self) -> str:
         if self._bound_port and self._address.endswith(":0"):
@@ -185,16 +422,14 @@ class GrpcServerTransport(ServerTransport):
 
 
 class GrpcClientTransport(ClientTransport):
-    def __init__(self, request_timeout_s: float = 30.0):
-        self._pool = _ChannelPool()
+    def __init__(self, request_timeout_s: float = 30.0,
+                 tls: Optional[GrpcTlsConfig] = None):
+        self._pool = _ChannelPool(tls)
         self.request_timeout_s = request_timeout_s
 
     async def send_request(self, peer_address: str,
                            request: RaftClientRequest) -> RaftClientReply:
-        channel = self._pool.get(peer_address)
-        call = channel.unary_unary(_REQUEST_METHOD,
-                                   request_serializer=_identity,
-                                   response_deserializer=_identity)
+        call = self._pool.unary(peer_address, _REQUEST_METHOD)
         timeout = (request.timeout_ms / 1000.0 if request.timeout_ms > 0
                    else self.request_timeout_s)
         try:
@@ -226,10 +461,12 @@ class GrpcTransportFactory(TransportFactory):
                 RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_KEY,
                 RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_DEFAULT).seconds
         return GrpcServerTransport(peer_id, address, server_handler,
-                                   client_handler, peer_resolver, timeout_s)
+                                   client_handler, peer_resolver, timeout_s,
+                                   tls=GrpcTlsConfig.from_properties(properties))
 
     def new_client_transport(self, properties=None) -> ClientTransport:
-        return GrpcClientTransport()
+        return GrpcClientTransport(
+            tls=GrpcTlsConfig.from_properties(properties))
 
 
 TransportFactory.register("GRPC", GrpcTransportFactory())

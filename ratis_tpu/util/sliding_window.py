@@ -76,15 +76,25 @@ class SlidingWindowServer(Generic[REQ]):
         self._pending: dict[int, REQ] = {}
         self._drain_lock = asyncio.Lock()
 
-    async def receive(self, seq: int, is_first: bool, request: REQ) -> None:
-        if is_first or self._next_to_process is None:
+    async def receive(self, seq: int, is_first: bool, request: REQ) -> bool:
+        """Returns False for a duplicate of an already-processed seq — the
+        caller must answer it out-of-band (retry cache), since no process()
+        call will ever see it."""
+        if is_first:
             self._next_to_process = seq
             # A post-failover "first" request resets the window; anything
             # parked below it can never be processed — drop it.
             for stale in [s for s in self._pending if s < seq]:
                 del self._pending[stale]
+        elif self._next_to_process is None:
+            # Window not yet based: park until the first-flagged request
+            # arrives (it reorders ahead of this one in flight).  If it was
+            # lost, the client's retry re-flags the lowest outstanding seq
+            # as first and rebases us (SlidingWindow.java:277).
+            self._pending[seq] = request
+            return True
         if seq < self._next_to_process:
-            return  # duplicate of an already-processed request
+            return False  # duplicate of an already-processed request
         self._pending[seq] = request
         # Serialize processing: without the lock, a receive() arriving while a
         # predecessor's process() is awaited would dispatch out of order.
@@ -96,6 +106,14 @@ class SlidingWindowServer(Generic[REQ]):
                 # ordering is still guaranteed by the lock held across the await.
                 self._next_to_process += 1
                 await self._process(req)
+        return True
 
     def pending_count(self) -> int:
         return len(self._pending)
+
+    def drain_parked(self) -> list[REQ]:
+        """Remove and return every parked request (step-down/close: the
+        gaps they wait on will never be filled here)."""
+        parked = [self._pending[s] for s in sorted(self._pending)]
+        self._pending.clear()
+        return parked

@@ -195,7 +195,8 @@ class MiniCluster:
         from ratis_tpu.client import RaftClient
         return (RaftClient.builder()
                 .set_raft_group(group or self.group)
-                .set_transport(self.factory.new_client_transport())
+                .set_transport(
+                    self.factory.new_client_transport(self.properties))
                 .set_retry_policy(retry_policy)
                 .build())
 
@@ -232,7 +233,7 @@ class MiniCluster:
         """Minimal failover client: follow NotLeaderException hints, retry on
         not-ready (the full RaftClient lands with the client milestone)."""
         type_case = type_case or write_request_type()
-        client = self.factory.new_client_transport()
+        client = self.factory.new_client_transport(self.properties)
         target = server_id or next(iter(self.servers))
         deadline = asyncio.get_event_loop().time() + timeout
         last_exc: Optional[Exception] = None

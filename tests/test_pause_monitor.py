@@ -1,0 +1,49 @@
+"""Event-loop pause monitor (reference JvmPauseMonitor.java:38,145 wired at
+RaftServerProxy.java:243): a stalled loop is detected and leaderships are
+abdicated instead of lingering heartbeat-less."""
+
+import asyncio
+import time
+
+from minicluster import MiniCluster, fast_properties, run_with_new_cluster
+
+
+def test_pause_detected_and_leader_steps_down():
+    async def body(cluster: MiniCluster):
+        from ratis_tpu.server.pause_monitor import PauseMonitor
+        leader = await cluster.wait_for_leader()
+        assert (await cluster.send_write()).success
+        srv = cluster.servers[leader.member_id.peer_id]
+        assert srv.pause_monitor is not None
+        # Give the monitor a lower threshold than the engine's staleness
+        # sweep so the abdication deterministically comes from the monitor
+        # (in production either path may win the race — same outcome).
+        await srv.pause_monitor.close()
+        srv.pause_monitor = PauseMonitor(srv, stepdown_s=0.7)
+        srv.pause_monitor.start()
+        srv.engine.leadership_timeout_ms = 60_000
+        await asyncio.sleep(0.05)
+        # Stall the entire event loop the way a synchronous compile or
+        # GIL-holding native call would.
+        time.sleep(1.2)
+        await asyncio.sleep(0.3)  # let the monitor run its check
+        assert srv.pause_monitor.pause_count > 0
+        assert srv.pause_monitor.stepdown_count >= 1
+        assert not leader.is_leader()
+        # the cluster recovers: a (possibly new) leader serves writes
+        await cluster.wait_for_leader()
+        assert (await cluster.send_write()).success
+
+    run_with_new_cluster(3, body)
+
+
+def test_short_pauses_do_not_abdicate():
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        time.sleep(0.6)  # above warn, below the 1s step-down floor
+        await asyncio.sleep(0.2)
+        lead_monitor = cluster.servers[
+            leader.member_id.peer_id].pause_monitor
+        assert lead_monitor.stepdown_count == 0
+
+    run_with_new_cluster(3, body)

@@ -7,7 +7,8 @@ tests cover, driven over real localhost sockets through grpc.aio.
 
 import asyncio
 
-from minicluster import MiniCluster, free_port, run_with_new_cluster
+from minicluster import (MiniCluster, fast_properties, free_port,
+                         run_with_new_cluster)
 from ratis_tpu.protocol.admin import SetConfigurationMode
 from ratis_tpu.protocol.group import RaftGroup
 from ratis_tpu.protocol.ids import RaftPeerId
@@ -101,3 +102,60 @@ def test_grpc_watch_and_stale_read():
             assert sr.success and sr.message.content == b"1"
 
     run_with_new_cluster(3, t, rpc_type="GRPC")
+
+
+def test_grpc_tls_cluster(tmp_path):
+    """TLS-secured gRPC transport (reference GrpcTlsConfig +
+    GrpcServicesImpl.newNettyServerBuilder:197): a full cluster elects and
+    serves writes over TLS; both RPC planes (server-server incl. the append
+    stream, client-server) ride secure channels."""
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True)
+
+    from ratis_tpu.conf.keys import GrpcConfigKeys
+
+    p = fast_properties()
+    p.set(GrpcConfigKeys.Tls.ENABLED_KEY, "true")
+    p.set(GrpcConfigKeys.Tls.CERT_CHAIN_KEY, str(cert))
+    p.set(GrpcConfigKeys.Tls.PRIVATE_KEY_KEY, str(key))
+    p.set(GrpcConfigKeys.Tls.TRUST_ROOT_KEY, str(cert))
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for i in range(1, 4):
+                r = await client.io().send(b"INCREMENT")
+                assert r.success
+                assert r.message.content == str(i).encode()
+        # a plaintext client cannot talk to the TLS endpoint
+        from ratis_tpu.transport.grpc import GrpcClientTransport
+        insecure = GrpcClientTransport()
+        from ratis_tpu.protocol.exceptions import (RaftException,
+                                                   TimeoutIOException)
+        from ratis_tpu.protocol.ids import ClientId
+        from ratis_tpu.protocol.message import Message
+        from ratis_tpu.protocol.requests import (RaftClientRequest,
+                                                 write_request_type)
+        req = RaftClientRequest(ClientId.random_id(),
+                                leader.member_id.peer_id,
+                                cluster.group.group_id, 1,
+                                Message.value_of(b"INCREMENT"),
+                                type=write_request_type(), timeout_ms=2000)
+        srv = cluster.servers[leader.member_id.peer_id]
+        try:
+            await insecure.send_request(srv.address, req)
+            raise AssertionError("plaintext request succeeded against TLS")
+        except (RaftException, TimeoutIOException):
+            pass
+        finally:
+            await insecure.close()
+
+    run_with_new_cluster(3, t, rpc_type="GRPC", properties=p)
