@@ -160,6 +160,11 @@ class Division:
         # per-client ordered-async reorder windows (leader only; see
         # _write_ordered)
         self._client_windows: dict = {}
+        # peer -> last known commit index (reference CommitInfoCache,
+        # RaftServerImpl commitInfoCache): fed by our own commit advances,
+        # follower reply piggybacks (leader) and leader request piggybacks
+        # (follower); surfaced on every client reply.
+        self._commit_info: dict[RaftPeerId, int] = {}
 
         # admin state
         self.pending_reconf = None  # Optional[admin.PendingReconf]
@@ -317,9 +322,42 @@ class Division:
 
     # ---------------------------------------------------------- lifecycle
 
+    # ------------------------------------------------- live reconfiguration
+
+    def _reconfigurable_keys(self) -> list[str]:
+        K = RaftServerConfigKeys
+        return [K.Rpc.SLOWNESS_TIMEOUT_KEY,
+                K.Notification.NO_LEADER_TIMEOUT_KEY,
+                K.Snapshot.AUTO_TRIGGER_ENABLED_KEY,
+                K.Snapshot.AUTO_TRIGGER_THRESHOLD_KEY,
+                K.Snapshot.RETENTION_FILE_NUM_KEY,
+                K.Read.TIMEOUT_KEY]
+
+    async def _apply_reconfiguration(self, key: str, value) -> None:
+        """Re-read a runtime-tunable knob from properties (the value was
+        already stored by ReconfigurationManager)."""
+        p = self.server.properties
+        K = RaftServerConfigKeys
+        if key == K.Rpc.SLOWNESS_TIMEOUT_KEY:
+            self._slowness_timeout_s = K.Rpc.slowness_timeout(p).seconds
+        elif key == K.Notification.NO_LEADER_TIMEOUT_KEY:
+            self._no_leader_timeout_s = \
+                K.Notification.no_leader_timeout(p).seconds
+        elif key == K.Snapshot.AUTO_TRIGGER_ENABLED_KEY:
+            self._snapshot_auto = K.Snapshot.auto_trigger_enabled(p)
+        elif key == K.Snapshot.AUTO_TRIGGER_THRESHOLD_KEY:
+            self._snapshot_threshold = K.Snapshot.auto_trigger_threshold(p)
+        elif key == K.Snapshot.RETENTION_FILE_NUM_KEY:
+            self._snapshot_retention = K.Snapshot.retention_file_num(p)
+        elif key == K.Read.TIMEOUT_KEY:
+            self.read_timeout_s = K.Read.timeout(p).seconds
+
     async def start(self) -> None:
         self._running = True
         self._started_at_s = asyncio.get_event_loop().time()
+        for key in self._reconfigurable_keys():
+            self.server.reconfiguration.register(
+                key, self._apply_reconfiguration)
         snapshot_index = -1
         if self.storage is not None:
             # RECOVER path (reference ServerState.initialize:134): reload
@@ -392,6 +430,8 @@ class Division:
 
     async def close(self) -> None:
         self._running = False
+        self.server.reconfiguration.unregister_all(
+            self._reconfigurable_keys(), self._apply_reconfiguration)
         if self.election is not None:
             self.election.stop()
         if self._election_task is not None:
@@ -651,6 +691,8 @@ class Division:
                                           reason="append from leader")
         self._last_heard_leader_s = asyncio.get_event_loop().time()
         self.reset_election_deadline()
+        for pid, idx in req.commit_infos:
+            self.update_commit_info(RaftPeerId.value_of(pid), idx)
 
         # Inconsistency check (checkInconsistentAppendEntries:1661).
         if req.previous is not None:
@@ -1004,12 +1046,37 @@ class Division:
 
     # ------------------------------------------------------- client path
 
+    def update_commit_info(self, peer_id: RaftPeerId, commit: int) -> None:
+        if commit > self._commit_info.get(peer_id, -1):
+            self._commit_info[peer_id] = commit
+
+    def get_commit_infos(self) -> tuple:
+        """Cluster-wide commit picture for client replies
+        (reference CommitInfoProto list on RaftClientReply)."""
+        from ratis_tpu.protocol.requests import CommitInfo
+        self.update_commit_info(self.member_id.peer_id,
+                                self.state.log.get_last_committed_index())
+        known = {p.id for p in self.state.configuration.all_peers()}
+        return tuple(CommitInfo(pid, idx)
+                     for pid, idx in sorted(self._commit_info.items(),
+                                            key=lambda kv: kv[0].id)
+                     if pid in known)
+
     async def submit_client_request(self, req: RaftClientRequest) -> RaftClientReply:
         self.metrics.num_requests.inc()
         if req.replied_call_ids:
             # piggybacked retry-cache GC (RaftClientImpl.RepliedCallIds)
             self.retry_cache.evict_replied(req.client_id.to_bytes(),
                                            req.replied_call_ids)
+        reply = await self._submit_client_request_impl(req)
+        if reply is not None and not reply.commit_infos:
+            import dataclasses
+            reply = dataclasses.replace(reply,
+                                        commit_infos=self.get_commit_infos())
+        return reply
+
+    async def _submit_client_request_impl(self, req: RaftClientRequest
+                                          ) -> RaftClientReply:
         t = req.type.type
         if t == RequestType.WRITE:
             if req.slider_seq_num >= 0:
@@ -1071,7 +1138,9 @@ class Division:
         win = self._client_windows.get(cid)
         if win is None:
             from ratis_tpu.util.sliding_window import SlidingWindowServer
-            win = SlidingWindowServer(self._ordered_submit, name=str(req.client_id))
+            win = SlidingWindowServer(self._ordered_submit,
+                                      name=str(req.client_id),
+                                      on_drop=self._on_window_drop)
             self._client_windows[cid] = win
         win.last_used = asyncio.get_event_loop().time()
         self._sweep_client_windows()
@@ -1111,6 +1180,11 @@ class Division:
                 reply = await self._write_async(req, on_submitted=on_submitted)
                 if not fut.done():
                     fut.set_result(reply)
+            except asyncio.CancelledError:
+                # division closing: unblock the handler awaiting fut
+                if not fut.done():
+                    fut.cancel()
+                raise
             except Exception as e:
                 if not fut.done():
                     fut.set_exception(e)
@@ -1119,6 +1193,16 @@ class Division:
 
         self._spawn_bg(run())
         await submitted
+
+    def _on_window_drop(self, item) -> None:
+        """A window rebase discarded a parked request whose seq can never be
+        released (its client already moved on): resolve the reply future so
+        the handler coroutine doesn't leak."""
+        req, fut = item
+        if not fut.done():
+            fut.set_result(RaftClientReply.failure_reply(
+                req, RaftException(
+                    "superseded: ordered window rebased past this seqNum")))
 
     def _drain_client_windows(self, exception: Exception) -> None:
         """Step-down/close: fail requests still parked in reorder windows."""

@@ -203,6 +203,9 @@ class LogAppender:
             previous=prev,
             entries=entries,
             leader_commit=log.get_last_committed_index(),
+            # cluster-wide commit picture piggyback (CommitInfoCache)
+            commit_infos=tuple((str(c.server), c.commit_index)
+                               for c in div.get_commit_infos()),
         )
 
     # -------------------------------------------------------------- window
@@ -315,6 +318,8 @@ class LogAppender:
         if reply.result == AppendResult.SUCCESS:
             self.follower.commit_index = max(self.follower.commit_index,
                                              reply.follower_commit)
+            div.update_commit_info(self.follower.peer_id,
+                                   reply.follower_commit)
             # Cap the confirmed match at what THIS request actually verified
             # against our log (prev check + entries sent).  The follower's
             # raw flush_index may cover a stale tail from a previous term

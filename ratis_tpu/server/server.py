@@ -68,6 +68,9 @@ class RaftServer:
             leadership_timeout_ms=int(
                 RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2))
         self.pause_monitor = None  # started in start() when enabled
+        from ratis_tpu.conf.reconfiguration import ReconfigurationManager
+        # live property reconfiguration (divisions register their knobs)
+        self.reconfiguration = ReconfigurationManager(properties)
         # peer id -> network address, fed from every conf the server sees
         # (division conf syncs, staging, group adds); the resolver transports
         # dial by (reference PeerProxyMap's address source).

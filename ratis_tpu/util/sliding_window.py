@@ -69,9 +69,11 @@ class SlidingWindowServer(Generic[REQ]):
     seq == nextToProcess) plus any queued successors, or parks the request.
     """
 
-    def __init__(self, process: Callable[[REQ], Awaitable[None]], name: str = ""):
+    def __init__(self, process: Callable[[REQ], Awaitable[None]], name: str = "",
+                 on_drop: Optional[Callable[[REQ], None]] = None):
         self._process = process
         self._name = name
+        self._on_drop = on_drop  # parked item discarded by a first-rebase
         self._next_to_process: Optional[int] = None
         self._pending: dict[int, REQ] = {}
         self._drain_lock = asyncio.Lock()
@@ -80,12 +82,21 @@ class SlidingWindowServer(Generic[REQ]):
         """Returns False for a duplicate of an already-processed seq — the
         caller must answer it out-of-band (retry cache), since no process()
         call will ever see it."""
+        if self._next_to_process is not None and seq < self._next_to_process:
+            # Duplicate of an already-released request — even when flagged
+            # first: a late dup of a first request must NOT rewind the
+            # window (already-processed successors would never re-arrive,
+            # stalling everything behind a permanent gap).
+            return False
         if is_first:
             self._next_to_process = seq
             # A post-failover "first" request resets the window; anything
-            # parked below it can never be processed — drop it.
+            # parked below it can never be processed — hand it back so the
+            # caller resolves its reply future instead of leaking it.
             for stale in [s for s in self._pending if s < seq]:
-                del self._pending[stale]
+                item = self._pending.pop(stale)
+                if self._on_drop is not None:
+                    self._on_drop(item)
         elif self._next_to_process is None:
             # Window not yet based: park until the first-flagged request
             # arrives (it reorders ahead of this one in flight).  If it was
@@ -93,8 +104,6 @@ class SlidingWindowServer(Generic[REQ]):
             # as first and rebases us (SlidingWindow.java:277).
             self._pending[seq] = request
             return True
-        if seq < self._next_to_process:
-            return False  # duplicate of an already-processed request
         self._pending[seq] = request
         # Serialize processing: without the lock, a receive() arriving while a
         # predecessor's process() is awaited would dispatch out of order.

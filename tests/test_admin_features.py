@@ -1,0 +1,91 @@
+"""Commit-info piggybacking, live property reconfiguration, and the
+membership console demo (reference CommitInfoCache / Reconfigurable surface /
+examples.membership.server.Console)."""
+
+import asyncio
+
+import pytest
+
+from minicluster import MiniCluster, free_port, run_with_new_cluster
+from ratis_tpu.conf import RaftServerConfigKeys
+from ratis_tpu.conf.reconfiguration import ReconfigurationException
+
+
+def test_commit_infos_on_replies():
+    """Every client reply carries the cluster-wide commit picture
+    (reference RaftClientReply.getCommitInfos / CommitInfoCache)."""
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        for _ in range(3):
+            reply = await cluster.send_write()
+            assert reply.success
+        reply = await cluster.send_write()
+        infos = {str(c.server): c.commit_index for c in reply.commit_infos}
+        peers = {str(p.id) for p in cluster.group.peers}
+        assert set(infos) == peers, infos
+        # the leader's own entry reflects the just-committed write
+        assert max(infos.values()) >= reply.log_index
+        # follower entries are fresh within a heartbeat round
+        await asyncio.sleep(0.3)
+        reply = await cluster.send_write()
+        infos = {str(c.server): c.commit_index for c in reply.commit_infos}
+        assert all(v >= 1 for v in infos.values()), infos
+
+    run_with_new_cluster(3, body)
+
+
+def test_live_reconfiguration():
+    """Runtime-tunable keys apply to live divisions; unknown keys are
+    rejected (reference Reconfigurable/ReconfigurationException)."""
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        srv = next(iter(cluster.servers.values()))
+        div = next(iter(srv.divisions.values()))
+        K = RaftServerConfigKeys
+        assert div._slowness_timeout_s != 5.0
+        await srv.reconfiguration.reconfigure(
+            K.Rpc.SLOWNESS_TIMEOUT_KEY, "5s")
+        assert div._slowness_timeout_s == 5.0
+        await srv.reconfiguration.reconfigure(
+            K.Snapshot.AUTO_TRIGGER_THRESHOLD_KEY, "77")
+        assert div._snapshot_threshold == 77
+        assert K.Rpc.SLOWNESS_TIMEOUT_KEY \
+            in srv.reconfiguration.reconfigurable_properties()
+        with pytest.raises(ReconfigurationException):
+            await srv.reconfiguration.reconfigure(
+                "raft.server.storage.dir", "/tmp/nope")
+
+    run_with_new_cluster(3, body)
+
+
+def test_membership_console_script():
+    """The membership demo end to end: show/incr/query plus add/remove
+    changing the live configuration (reference Console.java:29)."""
+    from ratis_tpu.tools.membership_console import run_script
+
+    ports = [free_port() for _ in range(4)]
+    initial, extra = ports[:3], ports[3]
+
+    async def main():
+        out = await run_script(initial, [
+            "show",
+            "incr", "incr",
+            "query",
+            f"add {extra}",
+            "show",
+            "incr",
+            f"remove {initial[0]}",
+            "show",
+            "query",
+        ])
+        assert "cluster peers:" in out[0] and out[0].count("p") >= 3
+        assert out[3] == "counter = 2"
+        assert str(extra) in out[4]
+        assert f"p{extra}" in out[5]
+        assert out[6] == "counter = 3"
+        assert f"p{initial[0]}" not in out[8]
+        assert out[9] == "counter = 3"
+
+    asyncio.run(main())
