@@ -314,6 +314,27 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.LeaderElection.LEADER_STEP_DOWN_WAIT_TIME_KEY,
                 RaftServerConfigKeys.LeaderElection.LEADER_STEP_DOWN_WAIT_TIME_DEFAULT)
 
+    class Heartbeat:
+        """Multi-raft heartbeat coalescing (no reference analog — removes
+        the reference's O(groups) per-interval heartbeat RPC volume)."""
+
+        COALESCING_ENABLED_KEY = "raft.tpu.heartbeat.coalescing.enabled"
+        COALESCING_ENABLED_DEFAULT = True
+        COALESCING_WINDOW_KEY = "raft.tpu.heartbeat.coalescing.window"
+        COALESCING_WINDOW_DEFAULT = TimeDuration.millis(5)
+
+        @staticmethod
+        def coalescing_enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY,
+                RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_DEFAULT)
+
+        @staticmethod
+        def coalescing_window(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Heartbeat.COALESCING_WINDOW_KEY,
+                RaftServerConfigKeys.Heartbeat.COALESCING_WINDOW_DEFAULT)
+
     class PauseMonitor:
         """Event-loop pause monitor (reference JvmPauseMonitor.java:38)."""
 

@@ -60,8 +60,7 @@ class ReconfigurationManager:
                 f"(reconfigurable: {self.reconfigurable_properties()})")
         old = self.properties.get(key)
         if value is None:
-            self.properties.unset(key) if hasattr(self.properties, "unset") \
-                else self.properties.set(key, "")
+            self.properties.unset(key)
         else:
             self.properties.set(key, value)
         try:
@@ -71,7 +70,7 @@ class ReconfigurationManager:
             # roll the stored value back so properties reflect what is live
             if old is not None:
                 self.properties.set(key, old)
-            elif hasattr(self.properties, "unset"):
+            else:
                 self.properties.unset(key)
             raise
         LOG.info("reconfigured %s: %r -> %r", key, old, value)

@@ -28,7 +28,12 @@ from ratis_tpu.engine.state import (GroupBatchState, NO_DEADLINE,
                                     ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
 from ratis_tpu.ops import reference as ref
 
+# keep in sync with ops.quorum.PACK_SENTINEL (not imported here: engine
+# import must not eagerly pull in jax)
+_PACK_SENTINEL = -(2 ** 31)
+
 _SHARED_STEP = None
+_SHARED_FAST_STEP = None
 
 
 def _shared_step():
@@ -43,6 +48,18 @@ def _shared_step():
         # without a host round-trip.
         _SHARED_STEP = jax.jit(q.engine_step_resident, donate_argnums=(0,))
     return _SHARED_STEP
+
+
+def _shared_fast_step():
+    """Zero-dirty steady-state variant: packed events in, packed outs back."""
+    global _SHARED_FAST_STEP
+    if _SHARED_FAST_STEP is None:
+        import jax
+
+        from ratis_tpu.ops import quorum as q
+        _SHARED_FAST_STEP = jax.jit(q.engine_step_resident_fast,
+                                    donate_argnums=(0,))
+    return _SHARED_FAST_STEP
 
 
 class EngineListener(Protocol):
@@ -86,6 +103,9 @@ class QuorumEngine:
         self.use_device = use_device
         self._listeners: dict[int, EngineListener] = {}
         self._ack_ring: list[tuple[int, int, int, int]] = []  # (slot, peer, match, t)
+        # slot -> [flush | SENTINEL, deadline | SENTINEL]: high-rate scalar
+        # mutations packed into the fast tick instead of dirty-row refreshes
+        self._slot_updates: dict[int, list] = {}
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._running = False
@@ -97,7 +117,8 @@ class QuorumEngine:
         # O(leaders) python sweep to timeout/4.
         self._next_staleness_ms = 0
         self.metrics = {"ticks": 0, "acks": 0, "commit_advances": 0,
-                        "batched_dispatches": 0, "refresh_rows": 0}
+                        "batched_dispatches": 0, "refresh_rows": 0,
+                        "fast_ticks": 0, "refresh_ticks": 0}
 
     # -- registration --------------------------------------------------------
 
@@ -115,6 +136,38 @@ class QuorumEngine:
     def on_ack(self, slot: int, peer_slot: int, match_index: int) -> None:
         self._ack_ring.append((slot, peer_slot, match_index, self.clock.now_ms()))
         self._wake.set()
+
+    def on_flush(self, slot: int, flush_index: int) -> None:
+        """A log's flush frontier advanced: update the mirror and queue a
+        packed slot update for the fast tick path (these fire on every
+        append — routing them through mark_dirty would force the dirty-row
+        refresh on every tick)."""
+        s = self.state
+        if flush_index < int(s.flush_index[slot]):
+            # regression (follower truncate): rare — take the refresh path,
+            # the device-side scatter-max would ignore a lower value
+            s.flush_index[slot] = flush_index
+            s.mark_dirty(slot)
+            self._wake.set()
+            return
+        s.flush_index[slot] = flush_index
+        u = self._slot_updates.get(slot)
+        if u is None:
+            self._slot_updates[slot] = [flush_index, _PACK_SENTINEL]
+        elif u[0] == _PACK_SENTINEL or flush_index > u[0]:
+            u[0] = flush_index
+        self._wake.set()
+
+    def on_deadline(self, slot: int, deadline_ms: int) -> None:
+        """(Re-)arm a follower election deadline; same packed-update route.
+        No wake: a postponed deadline needs no immediate tick."""
+        s = self.state
+        s.election_deadline_ms[slot] = deadline_ms
+        u = self._slot_updates.get(slot)
+        if u is None:
+            self._slot_updates[slot] = [_PACK_SENTINEL, deadline_ms]
+        else:
+            u[1] = deadline_ms
 
     def regress_match(self, slot: int, peer_slot: int, match_index: int) -> None:
         """A follower provably lost acked entries (volatile-log restart):
@@ -177,6 +230,9 @@ class QuorumEngine:
         s.election_deadline_ms[mask] -= np.int32(delta)
         self._ack_ring = [(g, p, m, max(0, t - delta))
                           for g, p, m, t in self._ack_ring]
+        for u in self._slot_updates.values():
+            if u[1] != _PACK_SENTINEL and u[1] != NO_DEADLINE:
+                u[1] = max(0, u[1] - delta)
         self._next_staleness_ms = 0
         self._dev = None  # wholesale time shift: re-upload the device state
         return now - delta
@@ -193,6 +249,7 @@ class QuorumEngine:
         active = s.active
         if not active:
             s.dirty.clear()
+            self._slot_updates.clear()
             self._dev = None
             return
 
@@ -200,18 +257,30 @@ class QuorumEngine:
         # batched path applies the same events on device, keeping mirror and
         # device in agreement without ever downloading the [G, P] arrays.
         touched: set[int] = set(s.dirty)
-        for slot, peer, match, t in acks:
-            if s.match_index[slot, peer] < match:
-                s.match_index[slot, peer] = match
-            if s.last_ack_ms[slot, peer] < t:
-                s.last_ack_ms[slot, peer] = t
-            touched.add(slot)
+        if len(acks) > 16:
+            a = np.asarray(acks, np.int64)
+            g, p = a[:, 0], a[:, 1]
+            np.maximum.at(s.match_index, (g, p), a[:, 2].astype(np.int32))
+            np.maximum.at(s.last_ack_ms, (g, p), a[:, 3].astype(np.int32))
+            touched.update(int(x) for x in np.unique(g))
+        else:
+            for slot, peer, match, t in acks:
+                if s.match_index[slot, peer] < match:
+                    s.match_index[slot, peer] = match
+                if s.last_ack_ms[slot, peer] < t:
+                    s.last_ack_ms[slot, peer] = t
+                touched.add(slot)
 
         use_batched = (self.use_device
                        or len(active) >= self.scalar_fallback_threshold)
         if use_batched:
             changed = self._tick_batched(acks, now)
         else:
+            # flush advances queued as packed updates still need their
+            # slots' commit math in the scalar pass (mirror already has the
+            # values)
+            touched.update(self._slot_updates)
+            self._slot_updates.clear()
             # host-only mutations make any retained device copy stale; drop
             # it so a later crossing back over the threshold re-uploads
             s.dirty.clear()
@@ -296,6 +365,10 @@ class QuorumEngine:
                 s.dirty = set(range(dc))
                 acks = [(0, 0, -1, now)] * ec
                 self._tick_batched(acks, now)
+        # fast path (zero dirty rows): one compile per event bucket
+        for ec in event_counts:
+            s.dirty = set()
+            self._tick_batched([(0, 0, -1, now)] * ec, now)
         s.dirty = saved_dirty
         self._dev = None  # drop the prewarm device copy; re-upload on use
 
@@ -326,6 +399,28 @@ class QuorumEngine:
             b *= 4
         return b
 
+    def _pack_tick(self, acks, updates: dict) -> np.ndarray:
+        """Pack acks + slot updates into the [7, E] fast-tick array (column
+        layout documented at ops.quorum.engine_step_resident_fast)."""
+        n = len(acks) + len(updates)
+        ecap = self._bucket(n)
+        evp = np.full((7, ecap), _PACK_SENTINEL, np.int32)
+        evp[0] = 0
+        evp[1] = 0
+        evp[4] = 0
+        if acks:
+            a = np.asarray(acks, np.int32)  # [E, 4]
+            k = len(acks)
+            evp[:4, :k] = a.T
+            evp[4, :k] = 1
+        if updates:
+            k = len(acks)
+            for i, (slot, (flush, deadline)) in enumerate(updates.items()):
+                evp[0, k + i] = slot
+                evp[5, k + i] = flush
+                evp[6, k + i] = deadline
+        return evp
+
     def _tick_batched(self, acks, now: int) -> list[tuple[int, str, int]]:
         import jax.numpy as jnp
 
@@ -337,9 +432,31 @@ class QuorumEngine:
             # upload, after which only dirty rows and events travel.
             self._dev = self._upload_device_state()
             s.dirty.clear()
+            self._slot_updates.clear()  # the full upload carried them
 
-        # dirty-row refresh: O(changed slots) host->device
-        dirty = sorted(s.dirty)
+        if not s.dirty:
+            # Fast path (the steady state under load): two packed uploads,
+            # one packed download — profiling showed the unpacked step's 18
+            # small transfers costing more than the quorum math itself.
+            # Flush advances and deadline re-arms travel as packed updates
+            # alongside the acks, so routine traffic never needs a refresh.
+            self.metrics["fast_ticks"] += 1
+            step = _shared_fast_step()
+            updates, self._slot_updates = self._slot_updates, {}
+            res = step(self._dev, jnp.asarray(self._pack_tick(acks, updates)),
+                       jnp.asarray(np.array(
+                           [now, self.leadership_timeout_ms], np.int32)))
+            self._dev = res.state
+            out = np.asarray(res.out)
+            return self._collect_changed(out[0], out[1] != 0, out[2] != 0,
+                                         out[3] != 0)
+
+        # dirty-row refresh: O(changed slots) host->device.  Slots with
+        # queued packed updates fold in here — the mirror already holds
+        # their values, so the row refresh carries them.
+        self.metrics["refresh_ticks"] += 1
+        dirty = sorted(s.dirty | set(self._slot_updates))
+        self._slot_updates.clear()
         s.dirty.clear()
         self.metrics["refresh_rows"] += len(dirty)
         dcap = self._bucket(len(dirty))
@@ -375,11 +492,13 @@ class QuorumEngine:
 
         # downloads: only the [G] outputs (masks + commit values), never the
         # [G, P] state
-        new_commit_np = np.asarray(res.new_commit)
-        commit_changed_np = np.asarray(res.commit_changed)
-        timeouts_np = np.asarray(res.timeouts)
-        stale_np = np.asarray(res.stale)
+        return self._collect_changed(
+            np.asarray(res.new_commit), np.asarray(res.commit_changed),
+            np.asarray(res.timeouts), np.asarray(res.stale))
 
+    def _collect_changed(self, new_commit_np, commit_changed_np, timeouts_np,
+                         stale_np) -> list[tuple[int, str, int]]:
+        s = self.state
         changed: list[tuple[int, str, int]] = []
         for slot in np.nonzero(commit_changed_np)[0]:
             i = int(slot)

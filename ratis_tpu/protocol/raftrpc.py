@@ -301,6 +301,46 @@ class StartLeaderElectionReply:
         return StartLeaderElectionReply(RaftRpcHeader.from_dict(d["h"]), d["ok"])
 
 
+@dataclasses.dataclass(frozen=True)
+class CoalescedHeartbeat:
+    """Multi-raft heartbeat envelope: heartbeats from EVERY group a server
+    leads toward one destination server, folded into a single RPC.
+
+    No reference analog — the reference sends one heartbeat per group per
+    follower per interval (GrpcLogAppender heartbeat channel), which is the
+    O(groups) idle-RPC wall this framework's multi-raft axis removes.  The
+    envelope carries ordinary AppendEntriesRequests, so each group's
+    semantics are exactly the unary path's."""
+
+    items: tuple[AppendEntriesRequest, ...]
+
+    def to_dict(self) -> dict:
+        return {"i": [r.to_dict() for r in self.items]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "CoalescedHeartbeat":
+        return CoalescedHeartbeat(
+            tuple(AppendEntriesRequest.from_dict(x) for x in d["i"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class CoalescedHeartbeatReply:
+    """Per-item replies; None where the peer failed that group (e.g. it does
+    not serve it) — the sender treats those as per-follower RPC errors."""
+
+    items: tuple[Optional[AppendEntriesReply], ...]
+
+    def to_dict(self) -> dict:
+        return {"i": [None if r is None else r.to_dict()
+                      for r in self.items]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "CoalescedHeartbeatReply":
+        return CoalescedHeartbeatReply(
+            tuple(None if x is None else AppendEntriesReply.from_dict(x)
+                  for x in d["i"]))
+
+
 # --- generic envelope for transports ---------------------------------------
 
 _MSG_TYPES: dict[str, type] = {
@@ -309,6 +349,7 @@ _MSG_TYPES: dict[str, type] = {
     "snap_req": InstallSnapshotRequest, "snap_rep": InstallSnapshotReply,
     "readidx_req": ReadIndexRequest, "readidx_rep": ReadIndexReply,
     "sle_req": StartLeaderElectionRequest, "sle_rep": StartLeaderElectionReply,
+    "hb_batch_req": CoalescedHeartbeat, "hb_batch_rep": CoalescedHeartbeatReply,
 }
 _TYPE_TAGS = {v: k for k, v in _MSG_TYPES.items()}
 

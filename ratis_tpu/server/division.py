@@ -165,6 +165,8 @@ class Division:
         # follower reply piggybacks (leader) and leader request piggybacks
         # (follower); surfaced on every client reply.
         self._commit_info: dict[RaftPeerId, int] = {}
+        # memoized (own_commit, infos, wire_form); None = stale
+        self._ci_cache = None
 
         # admin state
         self.pending_reconf = None  # Optional[admin.PendingReconf]
@@ -305,8 +307,9 @@ class Division:
             return
         engine = self.server.engine
         deadline = engine.clock.now_ms() + int(self.random_election_timeout_s() * 1000)
-        engine.state.election_deadline_ms[self.engine_slot] = deadline
-        engine.state.mark_dirty(self.engine_slot)
+        # high-rate path (every append/heartbeat received re-arms): packed
+        # update, not a dirty-row refresh
+        engine.on_deadline(self.engine_slot, deadline)
 
     def _engine_set_role(self, role_code: int) -> None:
         if self.engine_slot >= 0:
@@ -315,10 +318,9 @@ class Division:
 
     def _engine_update_flush(self) -> None:
         if self.engine_slot >= 0:
-            st = self.server.engine.state
-            st.flush_index[self.engine_slot] = self.state.log.flush_index
-            st.mark_dirty(self.engine_slot)
-            self.server.engine.notify()
+            # high-rate path (every append flushes): packed update
+            self.server.engine.on_flush(self.engine_slot,
+                                        self.state.log.flush_index)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -950,6 +952,7 @@ class Division:
         (leader append, follower append, truncate rollback)."""
         self._assign_peer_slots()
         self._sync_conf_to_engine()
+        self._ci_cache = None  # membership changed: rebuild commit infos
         # Listener promoted to voting member: voting rights begin as soon as
         # the conf entry is in the log (Raft uses a conf once appended);
         # demotion waits for commit (see _on_conf_entry_applied).
@@ -1049,18 +1052,32 @@ class Division:
     def update_commit_info(self, peer_id: RaftPeerId, commit: int) -> None:
         if commit > self._commit_info.get(peer_id, -1):
             self._commit_info[peer_id] = commit
+            self._ci_cache = None
 
     def get_commit_infos(self) -> tuple:
         """Cluster-wide commit picture for client replies
-        (reference CommitInfoProto list on RaftClientReply)."""
+        (reference CommitInfoProto list on RaftClientReply).  Memoized:
+        every AppendEntries build and client reply reads this, so rebuilding
+        per call would tax the hot replication path."""
+        own = self.state.log.get_last_committed_index()
+        cache = self._ci_cache
+        if cache is not None and cache[0] == own:
+            return cache[1]
         from ratis_tpu.protocol.requests import CommitInfo
-        self.update_commit_info(self.member_id.peer_id,
-                                self.state.log.get_last_committed_index())
+        self.update_commit_info(self.member_id.peer_id, own)
         known = {p.id for p in self.state.configuration.all_peers()}
-        return tuple(CommitInfo(pid, idx)
-                     for pid, idx in sorted(self._commit_info.items(),
-                                            key=lambda kv: kv[0].id)
-                     if pid in known)
+        infos = tuple(CommitInfo(pid, idx)
+                      for pid, idx in sorted(self._commit_info.items(),
+                                             key=lambda kv: kv[0].id)
+                      if pid in known)
+        wire = tuple((str(c.server), c.commit_index) for c in infos)
+        self._ci_cache = (own, infos, wire)
+        return infos
+
+    def get_commit_infos_wire(self) -> tuple:
+        """(peer_id_str, commit) tuples for the AppendEntries piggyback."""
+        self.get_commit_infos()
+        return self._ci_cache[2]
 
     async def submit_client_request(self, req: RaftClientRequest) -> RaftClientReply:
         self.metrics.num_requests.inc()

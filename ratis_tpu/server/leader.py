@@ -204,8 +204,7 @@ class LogAppender:
             entries=entries,
             leader_commit=log.get_last_committed_index(),
             # cluster-wide commit picture piggyback (CommitInfoCache)
-            commit_infos=tuple((str(c.server), c.commit_index)
-                               for c in div.get_commit_infos()),
+            commit_infos=div.get_commit_infos_wire(),
         )
 
     # -------------------------------------------------------------- window
@@ -288,11 +287,17 @@ class LogAppender:
         self._wake.set()
 
     async def _send(self, request: AppendEntriesRequest, epoch: int,
-                    pipelined: bool) -> None:
+                    pipelined: bool, coalesce: bool = False) -> None:
         div = self.division
         try:
-            reply = await div.server.send_server_rpc(
-                self.follower.peer_id, request)
+            if coalesce:
+                # multi-raft heartbeat batching: one RPC per destination
+                # server per window, carrying every group's heartbeat
+                reply = await div.server.heartbeats.submit(
+                    self.follower.peer_id, request)
+            else:
+                reply = await div.server.send_server_rpc(
+                    self.follower.peer_id, request)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -386,7 +391,8 @@ class LogAppender:
             if hb is None:
                 continue  # snapshot path owns this follower right now
             self._last_send_s = time.monotonic()
-            self._spawn(self._send(hb, self._epoch, pipelined=False))
+            self._spawn(self._send(hb, self._epoch, pipelined=False,
+                                   coalesce=div.server.heartbeat_coalescing))
 
 
 class LeaderContext:

@@ -22,6 +22,8 @@ from ratis_tpu.protocol.exceptions import (AlreadyExistsException,
 from ratis_tpu.protocol.group import RaftGroup
 from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
 from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest,
+                                        CoalescedHeartbeat,
+                                        CoalescedHeartbeatReply,
                                         InstallSnapshotRequest,
                                         ReadIndexRequest, RequestVoteRequest,
                                         StartLeaderElectionRequest)
@@ -36,6 +38,86 @@ LOG = logging.getLogger(__name__)
 
 # StateMachine registry: groupId -> StateMachine instance
 StateMachineRegistry = Callable[[RaftGroupId], StateMachine]
+
+
+class HeartbeatCoalescer:
+    """Folds heartbeats from every co-hosted group toward one destination
+    server into a single RPC per flush window.
+
+    The reference sends one heartbeat per group per follower per interval
+    (GrpcLogAppender's heartbeat channel) — an O(groups) idle-RPC wall on
+    the multi-raft axis this server removes: appenders submit their built
+    AppendEntries heartbeat here and a short window (default 5ms) batches
+    everything bound for the same peer into one CoalescedHeartbeat
+    envelope.  Reply handling, epochs and slowness detection stay entirely
+    in the per-follower appender; only the transport round trips change."""
+
+    def __init__(self, server: "RaftServer", window_s: float = 0.005):
+        self.server = server
+        self.window_s = window_s
+        self._queues: dict[RaftPeerId, list] = {}
+        self._flushers: dict[RaftPeerId, asyncio.Task] = {}
+        self.metrics = {"batches": 0, "heartbeats": 0}
+
+    def submit(self, to: RaftPeerId, request) -> "asyncio.Future":
+        """Queue one group's heartbeat to ``to``; resolves with its
+        AppendEntriesReply (or raises like a failed unary RPC)."""
+        fut = asyncio.get_event_loop().create_future()
+        self._queues.setdefault(to, []).append((request, fut))
+        if to not in self._flushers:
+            self._flushers[to] = asyncio.create_task(self._flush(to))
+        return fut
+
+    async def _flush(self, to: RaftPeerId) -> None:
+        from ratis_tpu.protocol.exceptions import TimeoutIOException
+        try:
+            await asyncio.sleep(self.window_s)
+        finally:
+            self._flushers.pop(to, None)
+        batch = self._queues.pop(to, [])
+        if not batch:
+            return
+        self.metrics["batches"] += 1
+        self.metrics["heartbeats"] += len(batch)
+        try:
+            reply = await self.server.send_server_rpc(
+                to, CoalescedHeartbeat(tuple(r for r, _ in batch)))
+            items = reply.items
+            if len(items) != len(batch):
+                raise TimeoutIOException("coalesced reply length mismatch")
+        except asyncio.CancelledError:
+            self._fail(batch, "coalescer closing")
+            raise
+        except Exception as e:
+            self._fail(batch, str(e))
+            return
+        for (_, fut), item in zip(batch, items):
+            if fut.done():
+                continue
+            if item is None:
+                fut.set_exception(TimeoutIOException(
+                    f"{to} failed this group's heartbeat"))
+            else:
+                fut.set_result(item)
+
+    def _fail(self, batch, reason: str) -> None:
+        from ratis_tpu.protocol.exceptions import TimeoutIOException
+        for _, fut in batch:
+            if not fut.done():
+                fut.set_exception(
+                    TimeoutIOException(f"coalesced heartbeat: {reason}"))
+
+    async def close(self) -> None:
+        for task in list(self._flushers.values()):
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._flushers.clear()
+        for to, batch in self._queues.items():
+            self._fail(batch, "server closing")
+        self._queues.clear()
 
 
 class RaftServer:
@@ -71,6 +153,10 @@ class RaftServer:
         from ratis_tpu.conf.reconfiguration import ReconfigurationManager
         # live property reconfiguration (divisions register their knobs)
         self.reconfiguration = ReconfigurationManager(properties)
+        self.heartbeats = HeartbeatCoalescer(
+            self, RaftServerConfigKeys.Heartbeat.coalescing_window(p).seconds)
+        self.heartbeat_coalescing = \
+            RaftServerConfigKeys.Heartbeat.coalescing_enabled(p)
         # peer id -> network address, fed from every conf the server sees
         # (division conf syncs, staging, group adds); the resolver transports
         # dial by (reference PeerProxyMap's address source).
@@ -166,6 +252,9 @@ class RaftServer:
                               div.member_id)
             await div.close()
         self.divisions.clear()
+        # after divisions: a live leader appender could otherwise submit a
+        # heartbeat that recreates a flusher task in a closed coalescer
+        await self.heartbeats.close()
         await self.engine.close()
         self.life_cycle.transition(LifeCycleState.CLOSED)
 
@@ -260,6 +349,8 @@ class RaftServer:
     # ------------------------------------------------------------- routing
 
     async def _handle_server_rpc(self, msg):
+        if isinstance(msg, CoalescedHeartbeat):
+            return await self._handle_coalesced_heartbeat(msg)
         div = self.get_division(msg.header.group_id)
         if isinstance(msg, AppendEntriesRequest):
             return await div.handle_append_entries(msg)
@@ -272,6 +363,23 @@ class RaftServer:
         if isinstance(msg, StartLeaderElectionRequest):
             return await div.handle_start_leader_election(msg)
         raise RaftException(f"unknown server rpc {type(msg).__name__}")
+
+    async def _handle_coalesced_heartbeat(self, env: CoalescedHeartbeat
+                                          ) -> CoalescedHeartbeatReply:
+        """Fan a heartbeat envelope out to its divisions; groups are
+        independent, so handling is concurrent (each division's append lock
+        still serializes within the group).  A group this server doesn't
+        host yields None — a per-group error, not an envelope failure."""
+
+        async def one(req):
+            try:
+                div = self.get_division(req.header.group_id)
+                return await div.handle_append_entries(req)
+            except Exception:
+                return None
+
+        items = await asyncio.gather(*(one(r) for r in env.items))
+        return CoalescedHeartbeatReply(tuple(items))
 
     async def _handle_client_request(self, request: RaftClientRequest
                                      ) -> RaftClientReply:

@@ -102,9 +102,9 @@ class SlidingWindowServer(Generic[REQ]):
             # arrives (it reorders ahead of this one in flight).  If it was
             # lost, the client's retry re-flags the lowest outstanding seq
             # as first and rebases us (SlidingWindow.java:277).
-            self._pending[seq] = request
+            self._park(seq, request)
             return True
-        self._pending[seq] = request
+        self._park(seq, request)
         # Serialize processing: without the lock, a receive() arriving while a
         # predecessor's process() is awaited would dispatch out of order.
         async with self._drain_lock:
@@ -116,6 +116,15 @@ class SlidingWindowServer(Generic[REQ]):
                 self._next_to_process += 1
                 await self._process(req)
         return True
+
+    def _park(self, seq: int, request: REQ) -> None:
+        """Park a request; a retry displacing an already-parked copy of the
+        same seq hands the old item to on_drop so its reply future resolves
+        instead of leaking (the retry's future is the live one)."""
+        old = self._pending.get(seq)
+        self._pending[seq] = request
+        if old is not None and self._on_drop is not None:
+            self._on_drop(old)
 
     def pending_count(self) -> int:
         return len(self._pending)

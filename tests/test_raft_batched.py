@@ -172,3 +172,36 @@ def test_64_groups_concurrent_writes_and_restart():
         assert lead.state_machine.counter >= WRITES_PER_GROUP
 
     run_batched(3, body)
+
+
+def test_heartbeat_coalescing_across_groups():
+    """Idle heartbeat RPC volume is O(server pairs), not O(groups): many
+    groups' heartbeats toward one peer fold into single envelopes."""
+
+    N_GROUPS = 8
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        groups = [cluster.group]
+        for _ in range(N_GROUPS - 1):
+            g = _make_sibling_group(cluster.group)
+            for s in cluster.servers.values():
+                await s.group_add(g)
+            groups.append(g)
+        await asyncio.gather(*(
+            _wait_group_leader(cluster, g.group_id) for g in groups))
+        # let a few heartbeat intervals pass while idle
+        await asyncio.sleep(0.6)
+        batches = sum(s.heartbeats.metrics["batches"]
+                      for s in cluster.servers.values())
+        hbs = sum(s.heartbeats.metrics["heartbeats"]
+                  for s in cluster.servers.values())
+        assert batches > 0
+        assert hbs > batches, (hbs, batches)  # real folding happened
+        # correctness unaffected: writes commit on every group
+        for g in groups[:3]:
+            reply = await cluster.send(b"INCREMENT", group_id=g.group_id,
+                                       timeout=30.0)
+            assert reply.success
+
+    run_batched(3, body)
