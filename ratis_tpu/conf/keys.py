@@ -319,7 +319,9 @@ class RaftServerConfigKeys:
         the reference's O(groups) per-interval heartbeat RPC volume)."""
 
         COALESCING_ENABLED_KEY = "raft.tpu.heartbeat.coalescing.enabled"
-        COALESCING_ENABLED_DEFAULT = True
+        # Opt-in: pays on real multi-host networks where per-RPC framing
+        # dominates; pure overhead on in-process transports.
+        COALESCING_ENABLED_DEFAULT = False
         COALESCING_WINDOW_KEY = "raft.tpu.heartbeat.coalescing.window"
         COALESCING_WINDOW_DEFAULT = TimeDuration.millis(5)
 

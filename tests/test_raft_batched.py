@@ -190,6 +190,8 @@ def test_heartbeat_coalescing_across_groups():
             groups.append(g)
         await asyncio.gather(*(
             _wait_group_leader(cluster, g.group_id) for g in groups))
+        # sanity: the opt-in flag reached the servers
+        assert all(s.heartbeat_coalescing for s in cluster.servers.values())
         # let a few heartbeat intervals pass while idle
         await asyncio.sleep(0.6)
         batches = sum(s.heartbeats.metrics["batches"]
@@ -204,4 +206,7 @@ def test_heartbeat_coalescing_across_groups():
                                        timeout=30.0)
             assert reply.success
 
-    run_batched(3, body)
+    from minicluster import batched_properties
+    props = batched_properties()
+    props.set("raft.tpu.heartbeat.coalescing.enabled", "true")  # opt in
+    run_batched(3, body, properties=props)
