@@ -412,6 +412,17 @@ class GrpcConfigKeys:
 
     PREFIX = "raft.grpc"
 
+    # Separate client/admin plane endpoint (reference GrpcConfigKeys.Client/
+    # Admin port split, GrpcServicesImpl.java:197): when set, client requests
+    # are served on this port while server-to-server RPC stays on the main
+    # address. "" = share the main port.
+    CLIENT_PORT_KEY = "raft.grpc.client.port"
+
+    @staticmethod
+    def client_port(p: RaftProperties):
+        v = p.get(GrpcConfigKeys.CLIENT_PORT_KEY)
+        return int(v) if v else None
+
     class Tls:
         ENABLED_KEY = "raft.grpc.tls.enabled"
         ENABLED_DEFAULT = False

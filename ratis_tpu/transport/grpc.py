@@ -260,10 +260,17 @@ class GrpcServerTransport(ServerTransport):
                  peer_resolver: Optional[Callable[[RaftPeerId], Optional[str]]]
                  = None,
                  request_timeout_s: float = 3.0,
-                 tls: Optional[GrpcTlsConfig] = None):
+                 tls: Optional[GrpcTlsConfig] = None,
+                 client_port: Optional[int] = None):
         self.peer_id = peer_id
         self._address = address
         self._bound_port: Optional[int] = None
+        # optional dedicated client/admin endpoint (GrpcServicesImpl's
+        # separate client/admin ports); None = client service shares the
+        # server-to-server port
+        self.client_port = client_port
+        self._client_server: Optional[grpc.aio.Server] = None
+        self.bound_client_port: Optional[int] = None
         self.server_handler = server_handler
         self.client_handler = client_handler
         self.peer_resolver = peer_resolver
@@ -322,6 +329,13 @@ class GrpcServerTransport(ServerTransport):
                 out = [call_id, _ST_INTERNAL, str(e).encode()]
             yield msgpack.packb(out)
 
+    def _client_handlers(self):
+        return grpc.method_handlers_generic_handler(
+            CLIENT_SERVICE,
+            {"request": grpc.unary_unary_rpc_method_handler(
+                self._handle_client, request_deserializer=_identity,
+                response_serializer=_identity)})
+
     def _generic_handlers(self):
         server_handlers = grpc.method_handlers_generic_handler(
             SERVER_SERVICE,
@@ -331,31 +345,60 @@ class GrpcServerTransport(ServerTransport):
              "appendStream": grpc.stream_stream_rpc_method_handler(
                 self._handle_append_stream, request_deserializer=_identity,
                 response_serializer=_identity)})
-        client_handlers = grpc.method_handlers_generic_handler(
-            CLIENT_SERVICE,
-            {"request": grpc.unary_unary_rpc_method_handler(
-                self._handle_client, request_deserializer=_identity,
-                response_serializer=_identity)})
-        return [server_handlers, client_handlers]
+        if self.client_port is not None:
+            # dedicated client endpoint configured: the replication port
+            # must NOT serve the client plane (that's the point of the
+            # split — firewalling / isolation)
+            return [server_handlers]
+        return [server_handlers, self._client_handlers()]
+
+    def _bind(self, server: grpc.aio.Server, address: str) -> int:
+        if self.tls is not None:
+            return server.add_secure_port(address,
+                                          self.tls.server_credentials())
+        return server.add_insecure_port(address)
 
     async def start(self) -> None:
         self._server = grpc.aio.server(options=_CHANNEL_OPTIONS)
         self._server.add_generic_rpc_handlers(self._generic_handlers())
-        if self.tls is not None:
-            self._bound_port = self._server.add_secure_port(
-                self._address, self.tls.server_credentials())
-        else:
-            self._bound_port = self._server.add_insecure_port(self._address)
+        self._bound_port = self._bind(self._server, self._address)
         if self._bound_port == 0:
             raise RaftException(f"{self.peer_id}: cannot bind {self._address}")
         await self._server.start()
-        LOG.info("%s: grpc bound %s%s", self.peer_id, self.address,
-                 " (tls)" if self.tls is not None else "")
+        if self.client_port is not None:
+            # dedicated client/admin endpoint: client traffic cannot starve
+            # (or be starved by) the replication plane
+            try:
+                host = self._address.rsplit(":", 1)[0]
+                client_server = grpc.aio.server(options=_CHANNEL_OPTIONS)
+                client_server.add_generic_rpc_handlers(
+                    [self._client_handlers()])
+                self.bound_client_port = self._bind(
+                    client_server, f"{host}:{self.client_port}")
+                if self.bound_client_port == 0:
+                    raise RaftException(
+                        f"{self.peer_id}: cannot bind client port "
+                        f"{self.client_port}")
+                await client_server.start()
+                self._client_server = client_server
+            except BaseException:
+                # don't leak the already-listening replication server: the
+                # caller's close() is a no-op from the STARTING state
+                await self._server.stop(grace=0)
+                self._server = None
+                raise
+        LOG.info("%s: grpc bound %s%s%s", self.peer_id, self.address,
+                 " (tls)" if self.tls is not None else "",
+                 f" client-port {self.bound_client_port}"
+                 if self._client_server is not None else "")
 
     async def close(self) -> None:
         for stream in list(self._append_streams.values()):
             await stream.close()
         self._append_streams.clear()
+        if self._client_server is not None:
+            await self._client_server.stop(grace=0.2)
+            self._client_server = None
         if self._server is not None:
             await self._server.stop(grace=0.2)
             self._server = None
@@ -455,14 +498,18 @@ class GrpcTransportFactory(TransportFactory):
                              client_handler, properties=None,
                              peer_resolver=None) -> ServerTransport:
         timeout_s = 3.0
+        client_port = None
         if properties is not None:
-            from ratis_tpu.conf.keys import RaftServerConfigKeys
+            from ratis_tpu.conf.keys import (GrpcConfigKeys,
+                                             RaftServerConfigKeys)
             timeout_s = properties.get_time_duration(
                 RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_KEY,
                 RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_DEFAULT).seconds
+            client_port = GrpcConfigKeys.client_port(properties)
         return GrpcServerTransport(peer_id, address, server_handler,
                                    client_handler, peer_resolver, timeout_s,
-                                   tls=GrpcTlsConfig.from_properties(properties))
+                                   tls=GrpcTlsConfig.from_properties(properties),
+                                   client_port=client_port)
 
     def new_client_transport(self, properties=None) -> ClientTransport:
         return GrpcClientTransport(

@@ -159,3 +159,74 @@ def test_grpc_tls_cluster(tmp_path):
             await insecure.close()
 
     run_with_new_cluster(3, t, rpc_type="GRPC", properties=p)
+
+
+def test_grpc_separate_client_port():
+    """Client/admin traffic on its own port (reference GrpcConfigKeys
+    client/admin port split): client requests succeed on the dedicated
+    endpoint, and the replication plane's port does not serve them... while
+    the dedicated port serves no server-to-server RPC."""
+    from ratis_tpu.conf.keys import GrpcConfigKeys
+
+    client_ports = {f"s{i}": free_port() for i in range(3)}
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        # every server bound its dedicated client endpoint
+        for s in cluster.servers.values():
+            assert s.transport.bound_client_port \
+                == s.transport.client_port != None  # noqa: E711
+        # drive a write via the leader's client port
+        from ratis_tpu.transport.grpc import GrpcClientTransport
+        srv = cluster.servers[leader.member_id.peer_id]
+        host = srv.address.rsplit(":", 1)[0]
+        client = GrpcClientTransport()
+        try:
+            from ratis_tpu.protocol.ids import ClientId
+            from ratis_tpu.protocol.message import Message
+            from ratis_tpu.protocol.requests import (RaftClientRequest,
+                                                     write_request_type)
+            req = RaftClientRequest(ClientId.random_id(),
+                                    leader.member_id.peer_id,
+                                    cluster.group.group_id, 1,
+                                    Message.value_of(b"INCREMENT"),
+                                    type=write_request_type(),
+                                    timeout_ms=10000)
+            reply = await client.send_request(
+                f"{host}:{srv.transport.bound_client_port}", req)
+            assert reply.success, reply.exception
+            # the replication port no longer serves the client plane
+            from ratis_tpu.protocol.exceptions import (RaftException,
+                                                       TimeoutIOException)
+            req2 = RaftClientRequest(ClientId.random_id(),
+                                     leader.member_id.peer_id,
+                                     cluster.group.group_id, 2,
+                                     Message.value_of(b"INCREMENT"),
+                                     type=write_request_type(),
+                                     timeout_ms=2000)
+            try:
+                await client.send_request(srv.address, req2)
+                raise AssertionError(
+                    "replication port served a client request")
+            except (RaftException, TimeoutIOException):
+                pass
+        finally:
+            await client.close()
+
+    # per-peer ports: patch properties per server via a factory-level key is
+    # global, so use one port value per server id through a cluster subclass
+    class _PerPeerPorts(MiniCluster):
+        def _new_server(self, peer):
+            self.properties.set(GrpcConfigKeys.CLIENT_PORT_KEY,
+                                str(client_ports[str(peer.id)]))
+            return super()._new_server(peer)
+
+    async def _main():
+        cluster = _PerPeerPorts(3, rpc_type="GRPC")
+        await cluster.start()
+        try:
+            await t(cluster)
+        finally:
+            await cluster.close()
+
+    asyncio.run(_main())
