@@ -1,0 +1,149 @@
+"""Prometheus text exposition for the metrics registries.
+
+Reference analog: ratis-metrics exposes dropwizard registries through
+reporters (console/JMX, ratis-metrics-default); operators today scrape
+Prometheus, so this renders every registry in
+:class:`~ratis_tpu.metrics.registry.MetricRegistries` in text exposition
+format 0.0.4 and (optionally) serves it over a tiny dependency-free
+asyncio HTTP endpoint at ``/metrics``.
+
+Naming: ``ratis_<component>_<metric>`` with the registry prefix (the group
+member id) as a ``member`` label, e.g.::
+
+    ratis_server_numRequests{member="s0@group-1234"} 42
+    ratis_log_worker_flushTime_seconds{member="...",quantile="0.99"} 0.003
+
+Timers emit count/total plus p50/p99 quantile samples from their bounded
+reservoir (the dropwizard histogram analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+from typing import Optional
+
+from ratis_tpu.metrics.registry import MetricRegistries
+
+LOG = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def render_text(registries: Optional[MetricRegistries] = None) -> str:
+    """All registries in Prometheus text exposition format."""
+    regs = registries or MetricRegistries.global_registries()
+    lines: list[str] = []
+    seen_types: set[str] = set()
+    for info in regs.get_registry_infos():
+        reg = regs.get(info)
+        if reg is None:
+            continue
+        member = _escape_label(info.prefix)
+        base = f"{_sanitize(info.application)}_{_sanitize(info.component)}"
+        for metric, value in sorted(reg.snapshot().items()):
+            mname = f"{base}_{_sanitize(metric)}"
+            if isinstance(value, dict):  # timer snapshot
+                if mname not in seen_types:
+                    lines.append(f"# TYPE {mname}_seconds summary")
+                    seen_types.add(mname)
+                count = value.get("count", 0)
+                total = value.get("mean_s", 0.0) * count
+                lines.append(f'{mname}_seconds_count{{member="{member}"}} '
+                             f'{count}')
+                lines.append(f'{mname}_seconds_sum{{member="{member}"}} '
+                             f'{total:.9g}')
+                for key, q in (("p50_s", "0.5"), ("p99_s", "0.99")):
+                    if key in value:
+                        lines.append(
+                            f'{mname}_seconds{{member="{member}",'
+                            f'quantile="{q}"}} {value[key]:.9g}')
+            else:
+                num = _as_number(value)
+                if num is None:
+                    continue  # non-numeric gauge (e.g. an error string)
+                if mname not in seen_types:
+                    kind = "counter" if metric.lower().endswith(
+                        ("count", "total")) else "gauge"
+                    lines.append(f"# TYPE {mname} {kind}")
+                    seen_types.add(mname)
+                lines.append(f'{mname}{{member="{member}"}} {num:.9g}')
+    return "\n".join(lines) + "\n"
+
+
+def _as_number(value) -> Optional[float]:
+    if isinstance(value, bool):
+        return float(value)
+    if isinstance(value, (int, float)):
+        return float(value)
+    return None
+
+
+class MetricsHttpServer:
+    """Minimal asyncio HTTP scrape endpoint: GET /metrics.
+
+    Dependency-free on purpose (the environment bakes no prometheus
+    client); the exposition format is line-oriented text, so a tiny
+    handwritten responder is all a scraper needs."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registries: Optional[MetricRegistries] = None):
+        self.host = host
+        self.port = port
+        self.registries = registries
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.bound_port: Optional[int] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+        LOG.info("metrics endpoint on http://%s:%d/metrics",
+                 self.host, self.bound_port)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10.0)
+            # drain headers
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request_line.decode("latin-1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            if path.split("?")[0] in ("/metrics", "/"):
+                body = render_text(self.registries).encode()
+                head = (b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4; "
+                        b"charset=utf-8\r\n"
+                        b"Content-Length: " + str(len(body)).encode() +
+                        b"\r\nConnection: close\r\n\r\n")
+                writer.write(head + body)
+            else:
+                writer.write(b"HTTP/1.1 404 Not Found\r\n"
+                             b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass

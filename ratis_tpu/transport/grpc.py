@@ -382,8 +382,14 @@ class GrpcServerTransport(ServerTransport):
                 await client_server.start()
                 self._client_server = client_server
             except BaseException:
-                # don't leak the already-listening replication server: the
-                # caller's close() is a no-op from the STARTING state
+                # don't leak the already-listening servers: the caller's
+                # close() is a no-op from the STARTING state, and the client
+                # socket binds at add_*_port, before start()
+                try:
+                    await client_server.stop(grace=0)
+                except Exception:
+                    pass
+                self.bound_client_port = None
                 await self._server.stop(grace=0)
                 self._server = None
                 raise

@@ -90,3 +90,42 @@ def test_retry_cache_metrics():
         assert misses >= 1
 
     run_with_new_cluster(3, _test)
+
+
+def test_prometheus_exposition_and_http():
+    """Prometheus text rendering + the /metrics scrape endpoint."""
+    from ratis_tpu.metrics.prometheus import MetricsHttpServer, render_text
+
+    async def body(cluster):
+        await cluster.wait_for_leader()
+        for _ in range(3):
+            assert (await cluster.send_write()).success
+        text = render_text()
+        assert "# TYPE ratis_" in text
+        assert 'member="' in text
+        assert "_seconds_count{" in text  # timers rendered as summaries
+        assert "ratis_server_" in text and "ratis_log_" in text
+
+        server = MetricsHttpServer()
+        await server.start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.bound_port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(), 10.0)
+            writer.close()
+            assert b"200 OK" in data.split(b"\r\n", 1)[0]
+            assert b"ratis_" in data
+            # 404 for other paths
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.bound_port)
+            writer.write(b"GET /nope HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(), 10.0)
+            writer.close()
+            assert b"404" in data.split(b"\r\n", 1)[0]
+        finally:
+            await server.close()
+
+    run_with_new_cluster(3, body)

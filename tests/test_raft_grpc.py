@@ -230,3 +230,59 @@ def test_grpc_separate_client_port():
             await cluster.close()
 
     asyncio.run(_main())
+
+
+def test_grpc_client_port_with_advertised_client_address():
+    """The standard failover RaftClient works against dedicated client
+    ports when peers advertise client_address (RaftPeer.get_client_address;
+    without it, a split-port cluster would be unreachable to clients)."""
+    from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys
+    from ratis_tpu.conf.keys import GrpcConfigKeys
+    from ratis_tpu.models.counter import CounterStateMachine
+    from ratis_tpu.protocol.group import RaftGroup
+    from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+    from ratis_tpu.protocol.peer import RaftPeer as Peer
+    from ratis_tpu.server.server import RaftServer
+    from ratis_tpu.transport.base import TransportFactory
+    from ratis_tpu.client import RaftClient
+
+    async def main():
+        factory = TransportFactory.get("GRPC")
+        rpc_ports = [free_port() for _ in range(3)]
+        cli_ports = [free_port() for _ in range(3)]
+        peers = [Peer(RaftPeerId.value_of(f"s{i}"),
+                      address=f"127.0.0.1:{rpc_ports[i]}",
+                      client_address=f"127.0.0.1:{cli_ports[i]}")
+                 for i in range(3)]
+        group = RaftGroup.value_of(RaftGroupId.random_id(), peers)
+        servers = []
+        for i, peer in enumerate(peers):
+            p = RaftProperties()
+            RaftServerConfigKeys.Rpc.set_timeout(p, "100ms", "200ms")
+            RaftServerConfigKeys.Log.set_use_memory(p, True)
+            p.set(GrpcConfigKeys.CLIENT_PORT_KEY, str(cli_ports[i]))
+            s = RaftServer(peer.id, peer.address,
+                           state_machine_registry=lambda gid: CounterStateMachine(),
+                           properties=p, transport_factory=factory,
+                           group=group)
+            servers.append(s)
+        for s in servers:
+            await s.start()
+        try:
+            deadline = asyncio.get_event_loop().time() + 10
+            while not any(d.is_leader() for s in servers
+                          for d in s.divisions.values()):
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            client = (RaftClient.builder().set_raft_group(group)
+                      .set_transport(factory.new_client_transport()).build())
+            async with client:
+                for i in range(1, 4):
+                    r = await client.io().send(b"INCREMENT")
+                    assert r.success
+                    assert r.message.content == str(i).encode()
+        finally:
+            for s in servers:
+                await s.close()
+
+    asyncio.run(main())
