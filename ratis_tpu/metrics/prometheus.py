@@ -52,7 +52,8 @@ def render_text(registries: Optional[MetricRegistries] = None) -> str:
         base = f"{_sanitize(info.application)}_{_sanitize(info.component)}"
         for metric, value in sorted(reg.snapshot().items()):
             mname = f"{base}_{_sanitize(metric)}"
-            if isinstance(value, dict):  # timer snapshot
+            if isinstance(value, dict) and "p50_s" in value:
+                # a Timekeeper snapshot (count/mean_s/max_s/p50_s/p99_s)
                 if mname not in seen_types:
                     lines.append(f"# TYPE {mname}_seconds summary")
                     seen_types.add(mname)
@@ -61,12 +62,25 @@ def render_text(registries: Optional[MetricRegistries] = None) -> str:
                 lines.append(f'{mname}_seconds_count{{member="{member}"}} '
                              f'{count}')
                 lines.append(f'{mname}_seconds_sum{{member="{member}"}} '
-                             f'{total:.9g}')
+                             f'{_fmt(total)}')
                 for key, q in (("p50_s", "0.5"), ("p99_s", "0.99")):
                     if key in value:
                         lines.append(
                             f'{mname}_seconds{{member="{member}",'
-                            f'quantile="{q}"}} {value[key]:.9g}')
+                            f'quantile="{q}"}} {_fmt(value[key])}')
+            elif isinstance(value, dict):
+                # structured gauge (e.g. the commitInfos index map): flatten
+                # numeric sub-keys into per-key gauges
+                for sub, sval in sorted(value.items()):
+                    num = _as_number(sval)
+                    if num is None:
+                        continue
+                    sub_name = f"{mname}_{_sanitize(str(sub))}"
+                    if sub_name not in seen_types:
+                        lines.append(f"# TYPE {sub_name} gauge")
+                        seen_types.add(sub_name)
+                    lines.append(
+                        f'{sub_name}{{member="{member}"}} {_fmt(num)}')
             else:
                 num = _as_number(value)
                 if num is None:
@@ -76,8 +90,16 @@ def render_text(registries: Optional[MetricRegistries] = None) -> str:
                         ("count", "total")) else "gauge"
                     lines.append(f"# TYPE {mname} {kind}")
                     seen_types.add(mname)
-                lines.append(f'{mname}{{member="{member}"}} {num:.9g}')
+                lines.append(f'{mname}{{member="{member}"}} {_fmt(num)}')
     return "\n".join(lines) + "\n"
+
+
+def _fmt(num: float) -> str:
+    """Full-precision rendering: integers verbatim (a counter past 1e9 must
+    not collapse to 1e+09 and stall rate() queries), floats via repr."""
+    if isinstance(num, int) or (isinstance(num, float) and num.is_integer()):
+        return str(int(num))
+    return repr(float(num))
 
 
 def _as_number(value) -> Optional[float]:
@@ -141,6 +163,10 @@ class MetricsHttpServer:
             await writer.drain()
         except (asyncio.TimeoutError, ConnectionError):
             pass
+        except Exception:
+            # e.g. LimitOverrunError/ValueError from an oversized header
+            # line: never let a bad scraper leak task exceptions
+            LOG.debug("metrics endpoint: bad request", exc_info=True)
         finally:
             writer.close()
             try:

@@ -1,0 +1,136 @@
+"""Randomized fault injection under load (the reference's closest analogs:
+RaftExceptionBaseTest, TestRaftWithSimulatedRpc kill/restart suites, and the
+leader-election churn tests — folded into one linearizability-style check).
+
+Writers drive uniquely-tagged appends through the full client path while the
+cluster suffers random partitions, leader kills, and restarts.  After
+healing, the invariants are:
+
+1. every ACKED write is applied exactly once on every live replica
+   (retry-cache dedupe across failover means client retries must not
+   double-apply),
+2. all replicas applied the same sequence (state-machine determinism),
+3. un-acked writes appear at most once (a timed-out attempt may still have
+   committed — that's Raft; it must not appear twice).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from minicluster import MiniCluster, fast_properties
+from statemachines import RecordingStateMachine
+
+
+async def _chaos(cluster: MiniCluster, seed: int, duration_s: float,
+                 n_writers: int) -> None:
+    rng = random.Random(seed)
+    acked: list[bytes] = []
+    stop = asyncio.Event()
+
+    async def writer(wid: int):
+        i = 0
+        async with cluster.new_client() as client:
+            while not stop.is_set():
+                payload = f"w{wid}-{i}".encode()
+                i += 1
+                try:
+                    reply = await asyncio.wait_for(
+                        client.io().send(payload), 8.0)
+                    if reply.success:
+                        acked.append(payload)
+                except Exception:
+                    pass  # un-acked: may or may not have committed
+                await asyncio.sleep(rng.uniform(0, 0.02))
+
+    async def nemesis():
+        end = asyncio.get_event_loop().time() + duration_s
+        while asyncio.get_event_loop().time() < end:
+            await asyncio.sleep(rng.uniform(0.3, 0.8))
+            ids = list(cluster.servers)
+            if not ids:
+                continue
+            fault = rng.random()
+            if fault < 0.4 and len(cluster.servers) == 3:
+                # kill any one server, restart it shortly after
+                victim = rng.choice(ids)
+                await cluster.kill_server(victim)
+                await asyncio.sleep(rng.uniform(0.3, 0.9))
+                await cluster.restart_server(victim)
+            elif fault < 0.8:
+                # partition one node away, then heal
+                victim = rng.choice(ids)
+                others = [x for x in ids if x != victim]
+                cluster.network.partition([victim], others)
+                await asyncio.sleep(rng.uniform(0.3, 0.9))
+                cluster.network.unblock_all()
+            else:
+                # transient asymmetric blackhole
+                a, b = rng.sample(ids, 2)
+                cluster.network.block(a, b)
+                await asyncio.sleep(rng.uniform(0.2, 0.5))
+                cluster.network.unblock_all()
+
+    writers = [asyncio.create_task(writer(w)) for w in range(n_writers)]
+    await nemesis()
+    stop.set()
+    await asyncio.gather(*writers, return_exceptions=True)
+    cluster.network.unblock_all()
+
+    # heal: let replication and apply quiesce
+    leader = await cluster.wait_for_leader(timeout=20.0)
+    last = leader.state.log.get_last_committed_index()
+    await cluster.wait_applied(last, timeout=30.0)
+
+    seqs = {str(d.member_id): list(d.state_machine.applied)
+            for d in cluster.divisions()}
+    # 2) replica agreement
+    first = next(iter(seqs.values()))
+    for member, seq in seqs.items():
+        assert seq == first, (
+            f"replica divergence at {member}: {len(seq)} vs {len(first)}")
+    counts = {p: first.count(p) for p in set(first)}
+    # 3) nothing applied twice
+    dupes = {p: c for p, c in counts.items() if c > 1}
+    assert not dupes, f"duplicated applies: {dupes}"
+    # 1) every acked write applied exactly once
+    missing = [p for p in acked if counts.get(p, 0) != 1]
+    assert not missing, f"lost acked writes: {missing[:10]}"
+    assert len(acked) > 20, f"chaos run acked only {len(acked)} writes"
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_chaos_writes_survive_faults(seed):
+    async def main():
+        cluster = MiniCluster(3, properties=fast_properties(),
+                              sm_factory=RecordingStateMachine)
+        await cluster.start()
+        try:
+            await cluster.wait_for_leader()
+            await _chaos(cluster, seed=seed, duration_s=6.0, n_writers=4)
+        finally:
+            cluster.network.unblock_all()
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+def test_chaos_batched_engine(monkeypatch):
+    """Same chaos with the jitted batched engine on every tick."""
+
+    async def main():
+        from minicluster import batched_properties
+        cluster = MiniCluster(3, properties=batched_properties(),
+                              sm_factory=RecordingStateMachine)
+        await cluster.start()
+        try:
+            await cluster.wait_for_leader()
+            await _chaos(cluster, seed=7, duration_s=5.0, n_writers=3)
+            for s in cluster.servers.values():
+                assert s.engine.metrics["batched_dispatches"] > 0
+        finally:
+            cluster.network.unblock_all()
+            await cluster.close()
+
+    asyncio.run(main())
