@@ -203,10 +203,16 @@ class QuorumEngine:
 
     async def _run(self) -> None:
         while self._running:
-            try:
-                await asyncio.wait_for(self._wake.wait(), self.tick_interval_s)
-            except asyncio.TimeoutError:
-                pass
+            if self._wake.is_set():
+                # busy: events already queued — tick now, skip the timer
+                # allocation wait_for would make (hot at high group counts)
+                await asyncio.sleep(0)
+            else:
+                try:
+                    await asyncio.wait_for(self._wake.wait(),
+                                           self.tick_interval_s)
+                except asyncio.TimeoutError:
+                    pass
             self._wake.clear()
             await self.tick()
 

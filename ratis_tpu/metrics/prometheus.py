@@ -150,13 +150,23 @@ class MetricsHttpServer:
             parts = request_line.decode("latin-1").split()
             path = parts[1] if len(parts) >= 2 else "/"
             if path.split("?")[0] in ("/metrics", "/"):
-                body = render_text(self.registries).encode()
-                head = (b"HTTP/1.1 200 OK\r\n"
-                        b"Content-Type: text/plain; version=0.0.4; "
-                        b"charset=utf-8\r\n"
-                        b"Content-Length: " + str(len(body)).encode() +
-                        b"\r\nConnection: close\r\n\r\n")
-                writer.write(head + body)
+                try:
+                    body = render_text(self.registries).encode()
+                except Exception:
+                    # a rendering bug must be loud (the endpoint is how
+                    # operators see the server) and still answer HTTP
+                    LOG.warning("metrics endpoint: render failed",
+                                exc_info=True)
+                    writer.write(b"HTTP/1.1 500 Internal Server Error\r\n"
+                                 b"Content-Length: 0\r\n"
+                                 b"Connection: close\r\n\r\n")
+                else:
+                    head = (b"HTTP/1.1 200 OK\r\n"
+                            b"Content-Type: text/plain; version=0.0.4; "
+                            b"charset=utf-8\r\n"
+                            b"Content-Length: " + str(len(body)).encode() +
+                            b"\r\nConnection: close\r\n\r\n")
+                    writer.write(head + body)
             else:
                 writer.write(b"HTTP/1.1 404 Not Found\r\n"
                              b"Content-Length: 0\r\nConnection: close\r\n\r\n")

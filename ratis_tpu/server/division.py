@@ -859,11 +859,13 @@ class Division:
 
     # ------------------------------------------------------- watch frontiers
 
-    def _update_watch_frontiers(self) -> None:
+    def _update_watch_frontiers(self, force: bool = False) -> None:
         """Recompute the four replication-level frontiers
         (LeaderStateImpl.commitIndexChanged:579 + watchRequests.update:986)."""
         if not self.is_leader() or self.leader_ctx is None:
             return
+        if not force and self.watch_requests.pending_count() == 0:
+            return  # runs on every follower ack; skip the math when idle
         log = self.state.log
         commit = log.get_last_committed_index()
         match_all = [log.flush_index]
@@ -1475,6 +1477,9 @@ class Division:
         err = self._check_leader(req)
         if err is not None:
             return err
+        # refresh stored frontiers first: the ack-path updates skip while no
+        # watches are pending, so they may be stale at registration
+        self._update_watch_frontiers(force=True)
         try:
             with self.metrics.watch_timer.time():
                 frontier = await self.watch_requests.watch(

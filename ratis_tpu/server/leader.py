@@ -366,11 +366,11 @@ class LogAppender:
                 continue
             self._wake.clear()
             self._fill_window()
-            try:
-                await asyncio.wait_for(self._wake.wait(),
-                                       self.heartbeat_interval_s)
-            except asyncio.TimeoutError:
-                pass
+            # Plain wait, no per-iteration wait_for timer: every completion
+            # path sets _wake (replies, errors via window reset, prefaults,
+            # snapshot installs), and the heartbeat loop doubles as the
+            # periodic waker so fills retry at least once per interval.
+            await self._wake.wait()
 
     async def _heartbeat_loop(self) -> None:
         """Dedicated heartbeat channel: an empty AppendEntries goes out
@@ -381,6 +381,7 @@ class LogAppender:
             await asyncio.sleep(self.heartbeat_interval_s)
             if not self._running or not div.is_leader():
                 return
+            self._wake.set()  # periodic fill retry for the main loop
             div.check_follower_slowness(self.follower)
             if (time.monotonic() - self._last_send_s
                     < self.heartbeat_interval_s * 0.9):
