@@ -202,6 +202,7 @@ class QuorumEngine:
             self._task = None
 
     async def _run(self) -> None:
+        loop = asyncio.get_event_loop()
         while self._running:
             if self._wake.is_set():
                 # busy: events already queued — tick now, skip the timer
@@ -214,7 +215,19 @@ class QuorumEngine:
                 except asyncio.TimeoutError:
                     pass
             self._wake.clear()
+            t0 = loop.time()
             await self.tick()
+            cost = loop.time() - t0
+            if cost > self.tick_interval_s:
+                # Self-pacing: a dispatch that cost more than the tick
+                # interval (big [G, P] batch on a slow backend) must not
+                # monopolize the event loop — sleeping roughly one tick-cost
+                # bounds the engine's duty cycle at ~50% and lets more acks
+                # accumulate per dispatch.  Capped: one pathological tick
+                # (wedged backend, measured wall-clock inflated by other
+                # coroutines) must not impose an unbounded second stall on
+                # every group's quorum/election processing.
+                await asyncio.sleep(min(cost, 8 * self.tick_interval_s))
 
     # -- the tick ------------------------------------------------------------
 

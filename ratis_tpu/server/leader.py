@@ -382,18 +382,28 @@ class LogAppender:
             if not self._running or not div.is_leader():
                 return
             self._wake.set()  # periodic fill retry for the main loop
-            div.check_follower_slowness(self.follower)
-            if (time.monotonic() - self._last_send_s
-                    < self.heartbeat_interval_s * 0.9):
-                continue  # recent traffic doubles as a heartbeat
-            if time.monotonic() < self._backoff_until:
-                continue
-            hb = self._build_request(self.follower.next_index, heartbeat=True)
-            if hb is None:
-                continue  # snapshot path owns this follower right now
-            self._last_send_s = time.monotonic()
-            self._spawn(self._send(hb, self._epoch, pipelined=False,
-                                   coalesce=div.server.heartbeat_coalescing))
+            try:
+                div.check_follower_slowness(self.follower)
+                if (time.monotonic() - self._last_send_s
+                        < self.heartbeat_interval_s * 0.9):
+                    continue  # recent traffic doubles as a heartbeat
+                if time.monotonic() < self._backoff_until:
+                    continue
+                hb = self._build_request(self.follower.next_index,
+                                         heartbeat=True)
+                if hb is None:
+                    continue  # snapshot path owns this follower right now
+                self._last_send_s = time.monotonic()
+                self._spawn(self._send(hb, self._epoch, pipelined=False,
+                                       coalesce=div.server.heartbeat_coalescing))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # this task is the sole periodic waker for the main loop —
+                # it must never die silently (the wake above already ran,
+                # so even a persistent error keeps fills retrying)
+                LOG.exception("%s heartbeat iteration failed",
+                              self.division.member_id)
 
 
 class LeaderContext:
