@@ -14,7 +14,8 @@ import sys
 from ratis_tpu.metrics.registry import (Counter, MetricRegistries,
                                         MetricRegistryInfo,
                                         RatisMetricRegistry, Timekeeper)
-from ratis_tpu.metrics.server_metrics import (LeaderElectionMetrics,
+from ratis_tpu.metrics.server_metrics import (DataStreamMetrics,
+                                              LeaderElectionMetrics,
                                               LogAppenderMetrics,
                                               LogWorkerMetrics,
                                               RaftServerMetrics,
@@ -25,7 +26,8 @@ __all__ = [
     "Counter", "MetricRegistries", "MetricRegistryInfo",
     "RatisMetricRegistry", "Timekeeper", "RaftServerMetrics",
     "LeaderElectionMetrics", "SegmentedRaftLogMetrics", "LogWorkerMetrics",
-    "LogAppenderMetrics", "StateMachineMetrics", "start_console_reporter",
+    "LogAppenderMetrics", "StateMachineMetrics", "DataStreamMetrics",
+    "start_console_reporter",
 ]
 
 

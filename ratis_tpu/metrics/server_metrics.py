@@ -148,6 +148,24 @@ class LogAppenderMetrics(_MetricsBase):
             self.registry.remove(f"follower_{peer_id}_{suffix}")
 
 
+class DataStreamMetrics(_MetricsBase):
+    """DataStream server packet/stream counters + latency (reference
+    NettyServerStreamRpcMetrics, ratis-netty/.../metrics/)."""
+
+    component = "datastream"
+    name = "netty_stream_server"
+
+    def __init__(self, member_id) -> None:
+        super().__init__(member_id)
+        r = self.registry
+        self.request_timer = r.timer("streamRequestLatency")
+        self.num_requests = r.counter("numRequests")
+        self.num_failed = r.counter("numFailedRequests")
+        self.bytes_written = r.counter("numBytesWritten")
+        self.streams_started = r.counter("numStreamsStarted")
+        self.streams_closed = r.counter("numStreamsClosed")
+
+
 class StateMachineMetrics(_MetricsBase):
     component = "state_machine"
     name = "state_machine"

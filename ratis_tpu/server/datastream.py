@@ -18,6 +18,7 @@ import logging
 import time
 from typing import Dict, Optional, Tuple
 
+from ratis_tpu.metrics import DataStreamMetrics
 from ratis_tpu.protocol.exceptions import DataStreamException
 from ratis_tpu.protocol.ids import RaftPeerId
 from ratis_tpu.protocol.requests import RaftClientRequest, RequestType
@@ -91,11 +92,13 @@ class DataStreamManagement:
         self._links: Dict[LinkKey, Tuple[StreamInfo, float]] = {}
         self._expiry_s = expiry_s
         self._last_sweep_s = time.monotonic()
+        self.metrics = DataStreamMetrics(str(server.peer_id))
 
     async def start(self) -> None:
         await self.transport.start()
 
     async def close(self) -> None:
+        self.metrics.unregister()
         await self.transport.close()
         for info in list(self._streams.values()):
             await self._cleanup(info)
@@ -127,24 +130,33 @@ class DataStreamManagement:
 
     async def _on_packet(self, packet: Packet, conn: PeerConnection) -> None:
         await self._expire_idle()
-        try:
-            if packet.kind == KIND_HEADER:
-                await self._on_header(packet)
-            elif packet.kind == KIND_DATA:
-                await self._on_data(packet)
-            else:
-                raise DataStreamException(f"unexpected kind {packet.kind}")
-        except Exception as e:
-            LOG.warning("datastream packet failed: %s", e)
-            await conn.send(Packet(KIND_REPLY, packet.stream_id,
-                                   packet.offset,
-                                   packet.flags & ~FLAG_SUCCESS, b""))
-            return
-        reply_data = b""
-        if packet.is_close:
-            reply_data = await self._finish(packet)
-        await conn.send(Packet(KIND_REPLY, packet.stream_id, packet.offset,
-                               packet.flags | FLAG_SUCCESS, reply_data))
+        self.metrics.num_requests.inc()
+        with self.metrics.request_timer.time():
+            reply_data = b""
+            try:
+                if packet.kind == KIND_HEADER:
+                    if packet.stream_id not in self._streams:
+                        self.metrics.streams_started.inc()
+                    await self._on_header(packet)
+                elif packet.kind == KIND_DATA:
+                    await self._on_data(packet)
+                    self.metrics.bytes_written.inc(len(packet.data))
+                else:
+                    raise DataStreamException(f"unexpected kind {packet.kind}")
+                if packet.is_close:
+                    # inside the try: a failing close must still answer the
+                    # client (failure reply) and count as failed
+                    reply_data = await self._finish(packet)
+                    self.metrics.streams_closed.inc()
+            except Exception as e:
+                LOG.warning("datastream packet failed: %s", e)
+                self.metrics.num_failed.inc()
+                await conn.send(Packet(KIND_REPLY, packet.stream_id,
+                                       packet.offset,
+                                       packet.flags & ~FLAG_SUCCESS, b""))
+                return
+            await conn.send(Packet(KIND_REPLY, packet.stream_id, packet.offset,
+                                   packet.flags | FLAG_SUCCESS, reply_data))
 
     async def _on_header(self, packet: Packet) -> None:
         request, routing = decode_header(packet.data)

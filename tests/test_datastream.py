@@ -98,6 +98,14 @@ def test_filestore_stream_end_to_end():
                 assert target.read_bytes() == payload
                 found += 1
         assert found == len(cluster.divisions())  # star routing reaches all
+        # stream metrics observed the traffic (NettyServerStreamRpcMetrics
+        # analog): bytes counted on the primary, stream opened and closed
+        m = [s.datastream.metrics for s in cluster.servers.values()
+             if s.datastream is not None]
+        assert sum(x.bytes_written.count for x in m) >= len(payload)
+        assert sum(x.streams_started.count for x in m) >= 1
+        assert sum(x.streams_closed.count for x in m) >= 1
+        assert all(x.num_failed.count == 0 for x in m)
 
     run_with_new_cluster(3, _test, sm_factory=FileStoreStateMachine)
 
