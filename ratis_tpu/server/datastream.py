@@ -135,9 +135,10 @@ class DataStreamManagement:
             reply_data = b""
             try:
                 if packet.kind == KIND_HEADER:
-                    if packet.stream_id not in self._streams:
-                        self.metrics.streams_started.inc()
+                    is_new = packet.stream_id not in self._streams
                     await self._on_header(packet)
+                    if is_new:  # count only opens that actually succeeded
+                        self.metrics.streams_started.inc()
                 elif packet.kind == KIND_DATA:
                     await self._on_data(packet)
                     self.metrics.bytes_written.inc(len(packet.data))

@@ -1610,18 +1610,22 @@ class Division:
         sm = self.state_machine
         while self._running:
             log = self.state.log
+            # clear BEFORE the commit check: a wake landing between check
+            # and clear would otherwise be lost, and this wait has no
+            # timeout (a poll timer per division is real churn at thousands
+            # of co-hosted groups)
+            self._apply_wake.clear()
             if self._applied_index >= log.get_last_committed_index():
-                self._apply_wake.clear()
-                try:
-                    await asyncio.wait_for(self._apply_wake.wait(), 1.0)
-                except asyncio.TimeoutError:
-                    continue
+                await self._apply_wake.wait()
             committed = log.get_last_committed_index()
             while self._applied_index < committed:
                 index = self._applied_index + 1
                 entry = log.get(index)
                 if entry is None:
-                    break  # purged or not yet local (snapshot install)
+                    # purged or not yet local (snapshot install in
+                    # progress): back off instead of spinning on the gap
+                    await asyncio.sleep(0.05)
+                    break
                 await self._apply_one(entry)
                 self._applied_index = index
                 sm.update_last_applied_term_index(entry.term, entry.index)
