@@ -140,7 +140,6 @@ class LogAppender:
         self.window_limit = max(1, window_limit)
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
-        self._hb_task: Optional[asyncio.Task] = None
         self._running = False
         self._epoch = 0        # bumped on window reset; stale replies ignored
         self._inflight = 0     # pipelined (non-heartbeat) requests outstanding
@@ -153,15 +152,14 @@ class LogAppender:
         self._running = True
         name = f"appender-{self.division.member_id}-{self.follower.peer_id}"
         self._task = asyncio.create_task(self._run(), name=name)
-        self._hb_task = asyncio.create_task(self._heartbeat_loop(),
-                                            name=name + "-hb")
 
     async def stop(self) -> None:
         self._running = False
         self._wake.set()
-        tasks = [t for t in (self._task, self._hb_task) if t is not None]
-        tasks += list(self._pending_sends)
-        self._task = self._hb_task = None
+        tasks = list(self._pending_sends)
+        if self._task is not None:
+            tasks.append(self._task)
+        self._task = None
         self._pending_sends.clear()
         for t in tasks:
             t.cancel()
@@ -372,38 +370,38 @@ class LogAppender:
             # periodic waker so fills retry at least once per interval.
             await self._wake.wait()
 
-    async def _heartbeat_loop(self) -> None:
-        """Dedicated heartbeat channel: an empty AppendEntries goes out
-        whenever nothing else has been sent for an interval, regardless of
-        window occupancy (GrpcLogAppender.java:172 heartbeat stream)."""
+    def on_heartbeat_sweep(self, now: float) -> None:
+        """One iteration of the dedicated heartbeat channel, driven by the
+        SERVER-level sweep (server.HeartbeatScheduler) instead of a task per
+        (division, follower) — at thousands of co-hosted groups, 2G standing
+        timer tasks were the scaling wall, and the sweep phase-aligns all
+        heartbeats toward a destination so coalescing folds them into one
+        RPC.  Semantics match the per-appender loop it replaces: an empty
+        AppendEntries goes out whenever nothing else has been sent for an
+        interval, regardless of window occupancy (GrpcLogAppender.java:172
+        heartbeat stream)."""
         div = self.division
-        while self._running and div.is_leader():
-            await asyncio.sleep(self.heartbeat_interval_s)
-            if not self._running or not div.is_leader():
+        if not self._running or not div.is_leader():
+            return
+        self._wake.set()  # periodic fill retry for the main loop
+        try:
+            div.check_follower_slowness(self.follower)
+            if now - self._last_send_s < self.heartbeat_interval_s * 0.9:
+                return  # recent traffic doubles as a heartbeat
+            if now < self._backoff_until:
                 return
-            self._wake.set()  # periodic fill retry for the main loop
-            try:
-                div.check_follower_slowness(self.follower)
-                if (time.monotonic() - self._last_send_s
-                        < self.heartbeat_interval_s * 0.9):
-                    continue  # recent traffic doubles as a heartbeat
-                if time.monotonic() < self._backoff_until:
-                    continue
-                hb = self._build_request(self.follower.next_index,
-                                         heartbeat=True)
-                if hb is None:
-                    continue  # snapshot path owns this follower right now
-                self._last_send_s = time.monotonic()
-                self._spawn(self._send(hb, self._epoch, pipelined=False,
-                                       coalesce=div.server.heartbeat_coalescing))
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                # this task is the sole periodic waker for the main loop —
-                # it must never die silently (the wake above already ran,
-                # so even a persistent error keeps fills retrying)
-                LOG.exception("%s heartbeat iteration failed",
-                              self.division.member_id)
+            hb = self._build_request(self.follower.next_index,
+                                     heartbeat=True)
+            if hb is None:
+                return  # snapshot path owns this follower right now
+            self._last_send_s = now
+            self._spawn(self._send(hb, self._epoch, pipelined=False,
+                                   coalesce=div.server.heartbeat_coalescing))
+        except Exception:
+            # the sweep must never die on one follower's error — the wake
+            # above already ran, so fills keep retrying regardless
+            LOG.exception("%s heartbeat sweep iteration failed",
+                          self.division.member_id)
 
 
 class LeaderContext:
@@ -421,8 +419,9 @@ class LeaderContext:
         self.appenders: dict[RaftPeerId, LogAppender] = {}
         self.startup_index: int = -1  # the conf entry appended on election
         self.leader_ready = asyncio.get_event_loop().create_future()
-        hb = RaftServerConfigKeys.Rpc.timeout_min(p).seconds / 2
-        self._heartbeat_interval_s = hb
+        # shared with the server-level HeartbeatScheduler sweep — the two
+        # cadences must agree or heartbeat gaps silently grow
+        self._heartbeat_interval_s = division.server.heartbeat_interval_s
         self._buffer_byte_limit = \
             RaftServerConfigKeys.Log.Appender.buffer_byte_limit(p)
         self._window_limit = \

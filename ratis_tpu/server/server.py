@@ -40,6 +40,55 @@ LOG = logging.getLogger(__name__)
 StateMachineRegistry = Callable[[RaftGroupId], StateMachine]
 
 
+class HeartbeatScheduler:
+    """ONE periodic task per server sweeping every leader division's
+    appenders (replaces a heartbeat-timer task per (division, follower) —
+    2G standing tasks was the multi-raft scaling wall).  Each sweep wakes
+    the appender fill loops, runs slowness detection, and sends any due
+    heartbeats.  With coalescing enabled the sweep's phase alignment lets
+    the HeartbeatCoalescer fold a whole sweep into one RPC per destination;
+    without it, the sweep yields periodically so the burst of individual
+    sends never stalls the event loop."""
+
+    def __init__(self, server: "RaftServer", interval_s: float):
+        self.server = server
+        self.interval_s = interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    def start(self) -> None:
+        self._running = True
+        self._task = asyncio.create_task(
+            self._run(), name=f"heartbeats-{self.server.peer_id}")
+
+    async def close(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        import time as _time
+        while self._running:
+            await asyncio.sleep(self.interval_s)
+            now = _time.monotonic()
+            sweep = 0
+            for div in list(self.server.divisions.values()):
+                if not div.is_leader() or div.leader_ctx is None:
+                    continue
+                for appender in list(div.leader_ctx.appenders.values()):
+                    appender.on_heartbeat_sweep(now)
+                    sweep += 1
+                    if sweep % 256 == 0:
+                        # don't stall the loop for one giant synchronous
+                        # burst at thousands of co-hosted leaders
+                        await asyncio.sleep(0)
+
+
 class HeartbeatCoalescer:
     """Folds heartbeats from every co-hosted group toward one destination
     server into a single RPC per flush window.
@@ -157,6 +206,12 @@ class RaftServer:
             self, RaftServerConfigKeys.Heartbeat.coalescing_window(p).seconds)
         self.heartbeat_coalescing = \
             RaftServerConfigKeys.Heartbeat.coalescing_enabled(p)
+        # single source of truth for the heartbeat cadence (LeaderContext
+        # and the sweep must agree, or heartbeat gaps silently grow)
+        self.heartbeat_interval_s = \
+            RaftServerConfigKeys.Rpc.timeout_min(p).seconds / 2
+        self.heartbeat_scheduler = HeartbeatScheduler(
+            self, self.heartbeat_interval_s)
         # peer id -> network address, fed from every conf the server sees
         # (division conf syncs, staging, group adds); the resolver transports
         # dial by (reference PeerProxyMap's address source).
@@ -201,6 +256,7 @@ class RaftServer:
             from ratis_tpu.server.pause_monitor import PauseMonitor
             self.pause_monitor = PauseMonitor(self)
             self.pause_monitor.start()
+        self.heartbeat_scheduler.start()
         # Boot scan: recover every group found on disk
         # (reference RaftServerProxy.initGroups:257-288).
         root = self._storage_root()
@@ -238,6 +294,7 @@ class RaftServer:
         if self.pause_monitor is not None:
             await self.pause_monitor.close()
             self.pause_monitor = None
+        await self.heartbeat_scheduler.close()
         await self.transport.close()
         if self.datastream is not None:
             await self.datastream.close()
