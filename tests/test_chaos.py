@@ -78,10 +78,11 @@ async def _chaos(cluster: MiniCluster, seed: int, duration_s: float,
     await asyncio.gather(*writers, return_exceptions=True)
     cluster.network.unblock_all()
 
-    # heal: let replication and apply quiesce
-    leader = await cluster.wait_for_leader(timeout=20.0)
+    # heal: let replication and apply quiesce (generous: under the forced-
+    # batched CI mode a first-tick jit compile can stall recovery)
+    leader = await cluster.wait_for_leader(timeout=40.0)
     last = leader.state.log.get_last_committed_index()
-    await cluster.wait_applied(last, timeout=30.0)
+    await cluster.wait_applied(last, timeout=45.0)
 
     seqs = {str(d.member_id): list(d.state_machine.applied)
             for d in cluster.divisions()}
