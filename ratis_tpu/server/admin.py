@@ -36,7 +36,6 @@ from ratis_tpu.protocol.exceptions import (LeaderSteppingDownException,
                                            TransferLeadershipException)
 from ratis_tpu.protocol.message import Message
 from ratis_tpu.protocol.peer import RaftPeer
-from ratis_tpu.protocol.raftrpc import RaftRpcHeader, StartLeaderElectionRequest
 from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
 from ratis_tpu.server.config import PeerConfiguration, RaftConfiguration
 
@@ -225,18 +224,15 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
                 req, TransferLeadershipException(
                     f"{args.new_leader} is not a voting member of {conf}"))
     else:
-        # No explicit target: yield to the highest-priority up-to-date peer
-        # (reference checkPeersForYieldingLeader:1058).
-        me = conf.get_peer(div.member_id.peer_id)
-        my_priority = me.priority if me is not None else 0
-        candidates = [p for p in conf.voting_peers()
-                      if p.id != div.member_id.peer_id
-                      and p.priority > my_priority]
+        # No explicit target: yield to the highest-priority peer
+        # (reference checkPeersForYieldingLeader:1058; the loop below waits
+        # for it to catch up, unlike the auto-yield which requires it).
+        candidates = div.higher_priority_peers()
         if not candidates:
             return RaftClientReply.failure_reply(
                 req, TransferLeadershipException(
                     "no higher-priority peer to yield to"))
-        target = max(candidates, key=lambda p: p.priority)
+        target = candidates[0]
         target_id = target.id
 
     timeout_s = max(args.timeout_ms / 1000.0, 0.2)
@@ -261,15 +257,7 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
             if f is not None and f.match_index >= last \
                     and now - last_sent > 0.3:
                 last_sent = now
-                hdr = RaftRpcHeader(div.member_id.peer_id, target_id,
-                                    div.group_id)
-                last_ti = state.log.get_last_entry_term_index()
-                try:
-                    await div.server.send_server_rpc(
-                        target_id, StartLeaderElectionRequest(hdr, last_ti))
-                except Exception as e:
-                    LOG.warning("%s startLeaderElection to %s failed: %s",
-                                div.member_id, target_id, e)
+                await div._send_start_leader_election(target_id)
             await asyncio.sleep(0.02)
         return RaftClientReply.failure_reply(
             req, TransferLeadershipException(

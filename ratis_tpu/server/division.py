@@ -157,6 +157,7 @@ class Division:
             RaftServerConfigKeys.Notification.no_leader_timeout(p).seconds
         self._last_no_leader_notify_s = 0.0
         self._started_at_s = 0.0
+        self._last_yield_attempt_s = 0.0
         # per-client ordered-async reorder windows (leader only; see
         # _write_ordered)
         self._client_windows: dict = {}
@@ -716,8 +717,18 @@ class Division:
                     self.on_configuration_changed()
             self._engine_update_flush()
 
-        # Follower commit: min(leaderCommit, last local index).
-        commit = min(req.leader_commit, log.flush_index)
+        # Follower commit: only up to the frontier THIS request verified
+        # against the leader's log (Raft §5.3: min(leaderCommit, index of
+        # last new entry); the prev check transitively verifies everything
+        # at or below prev).  Capping at flush_index alone is unsafe: it can
+        # cover a stale uncommitted tail from an old term that a heartbeat
+        # never examined — committing it would commit an entry the current
+        # leader is about to truncate away (found by the chaos suite as a
+        # follower wedged on 'conflict at committed index').
+        covered = (req.entries[-1].index if req.entries
+                   else (req.previous.index if req.previous is not None
+                         else -1))
+        commit = min(req.leader_commit, covered, log.flush_index)
         if log.update_commit_index(commit, state.current_term, False):
             self._apply_wake.set()
 
@@ -901,6 +912,65 @@ class Division:
         if slot is not None and self.engine_slot >= 0:
             self.server.engine.regress_match(self.engine_slot, slot,
                                              follower.match_index)
+
+    def check_yield_to_higher_priority(self) -> None:
+        """Auto-yield (reference LeaderStateImpl.checkPeersForYieldingLeader
+        :1058, run at the checkLeadership cadence): a leader whose current
+        conf contains a strictly higher-priority, fully caught-up voting
+        peer fires a forced election on it — how setConfiguration priority
+        changes move leadership without an explicit transfer."""
+        if not self.is_leader() or self.leader_ctx is None \
+                or self.stepping_down or self.pending_reconf is not None:
+            return
+        conf = self.state.configuration
+        if conf.is_transitional():
+            return
+        now = asyncio.get_event_loop().time()
+        if now - self._last_yield_attempt_s < self._timeout_min_s:
+            return  # give the previous forced election a round to land
+        last = self.state.log.next_index - 1
+        target = None
+        # any caught-up AND LIVE peer above our priority qualifies (highest
+        # first) — a crashed top-priority peer must not block yielding to
+        # the next one, matching the reference's chooseUpToDateFollower
+        # over ALL higher-priority appenders.  Liveness = a reply within
+        # one election timeout (an idle log keeps match_index satisfied
+        # forever, so match alone can't prove the peer is up).
+        live_after = time.monotonic() - self._timeout_max_s
+        for p in self.higher_priority_peers():
+            f = self.leader_ctx.followers.get(p.id)
+            if f is not None and f.match_index >= last \
+                    and f.last_rpc_response_s >= live_after:
+                target = p
+                break
+        if target is None:
+            return  # none caught up yet; appenders keep catching them up
+        self._last_yield_attempt_s = now
+        LOG.info("%s yielding leadership to higher-priority %s",
+                 self.member_id, target.id)
+        self._spawn_bg(self._send_start_leader_election(target.id))
+
+    def higher_priority_peers(self) -> list:
+        """Voting peers with priority strictly above ours, highest first
+        (shared by auto-yield and the explicit no-target transfer)."""
+        conf = self.state.configuration
+        me = conf.get_peer(self.member_id.peer_id)
+        if me is None:
+            return []
+        return sorted((p for p in conf.voting_peers()
+                       if p.id != me.id and p.priority > me.priority),
+                      key=lambda p: -p.priority)
+
+    async def _send_start_leader_election(self, target_id: RaftPeerId) -> None:
+        from ratis_tpu.protocol.raftrpc import StartLeaderElectionRequest
+        hdr = RaftRpcHeader(self.member_id.peer_id, target_id, self.group_id)
+        last_ti = self.state.log.get_last_entry_term_index()
+        try:
+            await self.server.send_server_rpc(
+                target_id, StartLeaderElectionRequest(hdr, last_ti))
+        except Exception as e:
+            LOG.warning("%s startLeaderElection to %s failed: %s",
+                        self.member_id, target_id, e)
 
     def check_follower_slowness(self, follower: FollowerInfo) -> None:
         """Leader-side slow-follower detection (reference

@@ -145,6 +145,7 @@ class LogAppender:
         self._inflight = 0     # pipelined (non-heartbeat) requests outstanding
         self._last_send_s = 0.0
         self._backoff_until = 0.0
+        self._last_error_log_s = 0.0
         self._prefaulting = False
         self._pending_sends: set[asyncio.Task] = set()
 
@@ -298,10 +299,18 @@ class LogAppender:
                     self.follower.peer_id, request)
         except asyncio.CancelledError:
             raise
-        except Exception:
+        except Exception as e:
             if epoch == self._epoch and self._running:
                 # Connection trouble: drop the pipeline, retry after a pause
                 # paced by the heartbeat timer (GrpcLogAppender.onError).
+                # Log (rate-limited) — a silent persistent error here looks
+                # like a wedged follower with no trace of why.
+                now = time.monotonic()
+                if now - self._last_error_log_s > 2.0:
+                    self._last_error_log_s = now
+                    LOG.warning("%s -> %s append failed (epoch %d): %s",
+                                self.division.member_id,
+                                self.follower.peer_id, self._epoch, e)
                 self._reset_window(backoff_s=self.heartbeat_interval_s)
             return
         if epoch != self._epoch or not self._running:

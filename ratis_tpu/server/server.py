@@ -80,6 +80,7 @@ class HeartbeatScheduler:
             for div in list(self.server.divisions.values()):
                 if not div.is_leader() or div.leader_ctx is None:
                     continue
+                div.check_yield_to_higher_priority()
                 for appender in list(div.leader_ctx.appenders.values()):
                     appender.on_heartbeat_sweep(now)
                     sweep += 1

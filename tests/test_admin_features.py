@@ -89,3 +89,36 @@ def test_membership_console_script():
         assert out[9] == "counter = 3"
 
     asyncio.run(main())
+
+
+def test_leader_auto_yields_to_higher_priority_peer():
+    """Raising a follower's priority via setConfiguration moves leadership
+    to it automatically (reference checkPeersForYieldingLeader:1058) — no
+    explicit transferLeadership call."""
+    import dataclasses
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        assert (await cluster.send_write()).success
+        target = next(d for d in cluster.divisions() if not d.is_leader())
+        tid = target.member_id.peer_id
+        new_peers = [dataclasses.replace(p, priority=(5 if p.id == tid else 0))
+                     for p in cluster.group.peers]
+        async with cluster.new_client() as client:
+            reply = await client.admin().set_configuration(new_peers)
+            assert reply.success, reply.exception
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            leaders = [d for d in cluster.divisions() if d.is_leader()]
+            if leaders and leaders[-1].member_id.peer_id == tid \
+                    and len(leaders) == 1:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"leadership did not yield to {tid}; roles: "
+                f"{[(str(d.member_id), d.role.name) for d in cluster.divisions()]}")
+        # cluster still serves writes under the new leader
+        assert (await cluster.send_write()).success
+
+    run_with_new_cluster(3, body)

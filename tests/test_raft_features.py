@@ -241,3 +241,64 @@ class TestSnapshot:
                 await cluster.close()
 
         asyncio.run(main())
+
+
+def test_follower_commit_capped_at_verified_frontier():
+    """Raft §5.3: a follower advances commitIndex only to min(leaderCommit,
+    last index THIS request verified). A heartbeat with a high leaderCommit
+    must not commit a stale uncommitted tail from an old term — doing so
+    commits entries the current leader is about to truncate (regression:
+    chaos suite wedged a follower on 'conflict at committed index')."""
+    from ratis_tpu.protocol.ids import ClientId
+    from ratis_tpu.protocol.logentry import make_transaction_entry
+    from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest,
+                                            AppendResult, RaftRpcHeader)
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        follower = next(d for d in cluster.divisions() if not d.is_leader())
+        # Freeze real traffic into the chosen follower so the crafted
+        # requests fully control its log.
+        for d in cluster.divisions():
+            if d is not follower:
+                cluster.network.block(d.member_id.peer_id,
+                                      follower.member_id.peer_id)
+        await asyncio.sleep(0.05)
+        cid = ClientId.random_id().to_bytes()
+        term1 = follower.state.current_term + 1
+        base = follower.state.log.next_index
+        hdr = RaftRpcHeader(leader.member_id.peer_id,
+                            follower.member_id.peer_id, cluster.group.group_id)
+
+        def entries(term, start, n):
+            return tuple(make_transaction_entry(term, start + i, cid, start + i,
+                                                b"x") for i in range(n))
+
+        prev = follower.state.log.get_last_entry_term_index()
+        # stale tail: entries at term1 that will never commit
+        stale = entries(term1, base, 3)
+        reply = await follower.handle_append_entries(AppendEntriesRequest(
+            hdr, term1, prev, stale, leader_commit=base - 1))
+        assert reply.result == AppendResult.SUCCESS
+        committed_before = follower.state.log.get_last_committed_index()
+
+        # new term: heartbeat verifying only up to prev (below the stale
+        # tail) but advertising a commit beyond it
+        term2 = term1 + 1
+        reply = await follower.handle_append_entries(AppendEntriesRequest(
+            hdr, term2, prev, (), leader_commit=base + 2))
+        assert reply.result == AppendResult.SUCCESS
+        after = follower.state.log.get_last_committed_index()
+        assert after <= max(committed_before, prev.index if prev else -1), (
+            f"follower committed unverified stale tail: {after}")
+
+        # the new leader's conflicting entries truncate-and-append cleanly
+        fresh = entries(term2, base, 3)
+        reply = await follower.handle_append_entries(AppendEntriesRequest(
+            hdr, term2, prev, fresh, leader_commit=base + 2))
+        assert reply.result == AppendResult.SUCCESS
+        assert follower.state.log.get_term_index(base).term == term2
+        assert follower.state.log.get_last_committed_index() == base + 2
+        cluster.network.unblock_all()
+
+    run_with_new_cluster(3, body)
