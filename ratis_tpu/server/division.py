@@ -521,6 +521,15 @@ class Division:
         self.role = RaftPeerRole.CANDIDATE
         self._engine_set_role(ROLE_CANDIDATE)
         self.election = LeaderElection(self, force=force)
+        if force:
+            # Leadership-transfer target (dissertation §3.10 TimeoutNow):
+            # own the higher term IMMEDIATELY — the in-memory bump happens
+            # before any await — so the old leader's in-flight heartbeats
+            # (still at the old term) are rejected instead of demoting this
+            # candidacy before its vote requests ever go out.  The old
+            # leader steps down when it sees the higher term in replies.
+            await self.state.init_election_term()
+            self.election.term_pre_initialized = True
 
         async def _run_and_rearm():
             try:

@@ -47,6 +47,10 @@ class LeaderElection:
     def __init__(self, division, force: bool = False):
         self.division = division
         self.force = force  # transfer-leadership skips PRE_VOTE
+        # set by change_to_candidate(force=True): the term was already
+        # bumped + self-voted synchronously at candidacy start, so the
+        # ELECTION phase must not bump again
+        self.term_pre_initialized = False
         self._stopped = False
 
     def stop(self) -> None:
@@ -98,7 +102,8 @@ class LeaderElection:
         state = div.state
 
         if phase == Phase.ELECTION:
-            term = await state.init_election_term()
+            term = (state.current_term if self.term_pre_initialized
+                    else await state.init_election_term())
         else:
             term = state.current_term + 1  # probe term, nothing persisted
 
