@@ -350,7 +350,22 @@ class LogAppender:
                 hint = min(reply.next_index,
                            max(request.previous.index if request.previous
                                else 0, 0))
-                self._reset_window(rewind_to=hint)
+                f = self.follower
+                if hint <= f.match_index and (
+                        request.previous is None
+                        or request.previous.index != f.match_index):
+                    # Heartbeats travel unary/coalesced while entry appends
+                    # ride the ordered stream, so a stale heartbeat's
+                    # INCONSISTENCY can land after a newer SUCCESS raised
+                    # match in the same epoch.  This request never examined
+                    # our recorded match position, so its rejection is not
+                    # authoritative for a regress: reset the window and
+                    # re-probe at the match instead.  A genuine volatile-log
+                    # restart fails the probe (previous.index == match) too
+                    # and regresses then, via the authoritative branch.
+                    self._reset_window()
+                else:
+                    self._reset_window(rewind_to=hint)
         elif reply.result == AppendResult.NOT_LEADER:
             # stale term on our side already handled above; otherwise ignore
             pass

@@ -414,8 +414,13 @@ class SegmentedRaftLog(RaftLog):
 
     def is_resident(self, index: int) -> bool:
         seg = self._covering_segment(index)
-        return (seg is None or seg.cached
-                or seg.start in self._rt_cache)
+        if seg is None or seg.cached:
+            return True
+        # _rt_cache is mutated from prefault worker threads; the lock is
+        # uncontended and keeps this membership check from racing an LRU
+        # eviction into a synchronous whole-segment load on the event loop
+        with self._rt_lock:
+            return seg.start in self._rt_cache
 
     def prefault(self, index: int) -> None:
         """Blocking load of the segment covering ``index`` into the

@@ -60,6 +60,17 @@ class HeartbeatScheduler:
         self._running = True
         self._task = asyncio.create_task(
             self._run(), name=f"heartbeats-{self.server.peer_id}")
+        self._task.add_done_callback(self._on_exit)
+
+    def _on_exit(self, task: asyncio.Task) -> None:
+        """Belt-and-braces: if the sweep task ever dies while the server is
+        running (a bug the try/except in _run should make impossible),
+        restart it instead of silently losing every heartbeat forever."""
+        if not self._running or task.cancelled():
+            return
+        LOG.error("heartbeat sweep task for %s exited unexpectedly "
+                  "(%s); restarting", self.server.peer_id, task.exception())
+        self.start()
 
     async def close(self) -> None:
         self._running = False
@@ -78,16 +89,25 @@ class HeartbeatScheduler:
             now = _time.monotonic()
             sweep = 0
             for div in list(self.server.divisions.values()):
-                if not div.is_leader() or div.leader_ctx is None:
-                    continue
-                div.check_yield_to_higher_priority()
-                for appender in list(div.leader_ctx.appenders.values()):
-                    appender.on_heartbeat_sweep(now)
-                    sweep += 1
-                    if sweep % 256 == 0:
-                        # don't stall the loop for one giant synchronous
-                        # burst at thousands of co-hosted leaders
-                        await asyncio.sleep(0)
+                # One division's failure must never kill the single
+                # server-wide heartbeat task — that silently collapses every
+                # leadership on the server with no recovery path.
+                try:
+                    if not div.is_leader() or div.leader_ctx is None:
+                        continue
+                    div.check_yield_to_higher_priority()
+                    for appender in list(div.leader_ctx.appenders.values()):
+                        appender.on_heartbeat_sweep(now)
+                        sweep += 1
+                        if sweep % 256 == 0:
+                            # don't stall the loop for one giant synchronous
+                            # burst at thousands of co-hosted leaders
+                            await asyncio.sleep(0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    LOG.exception("heartbeat sweep failed for %s",
+                                  div.member_id)
 
 
 class HeartbeatCoalescer:
