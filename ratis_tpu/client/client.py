@@ -484,10 +484,10 @@ class DataStreamOutput:
         # write, which can block on a stalled primary's full receive buffer)
         # on ONE deadline derived from the header request's timeout.
         timeout_s = (self.request.timeout_ms or 30_000.0) / 1000.0
-        deadline = asyncio.get_event_loop().time() + timeout_s
+        deadline = asyncio.get_running_loop().time() + timeout_s
 
         def remaining() -> float:
-            return max(0.001, deadline - asyncio.get_event_loop().time())
+            return max(0.001, deadline - asyncio.get_running_loop().time())
 
         async def _send_close_and_wait(pkt):
             return await (await self._conn.send(pkt))

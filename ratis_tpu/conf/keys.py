@@ -162,6 +162,17 @@ class RaftServerConfigKeys:
             PIPELINE_WINDOW_DEFAULT = 16  # in-flight AppendEntries per follower
             WAIT_TIME_MIN_KEY = "raft.server.log.appender.wait-time.min"
             WAIT_TIME_MIN_DEFAULT = TimeDuration.millis(10)
+            # Data-path coalescing (no reference analog — the reference runs
+            # one stream per (group, follower), GrpcLogAppender.java:356):
+            # fold every group's append batches toward one destination into
+            # a single AppendEnvelope RPC per flush.  Disabled = one unary
+            # RPC per batch (the reference's cost shape).
+            COALESCING_ENABLED_KEY = "raft.server.log.appender.coalescing.enabled"
+            COALESCING_ENABLED_DEFAULT = True
+            ENVELOPE_INFLIGHT_KEY = "raft.server.log.appender.envelope.inflight"
+            ENVELOPE_INFLIGHT_DEFAULT = 4  # concurrent envelopes per peer
+            ENVELOPE_BYTE_LIMIT_KEY = "raft.server.log.appender.envelope.byte-limit"
+            ENVELOPE_BYTE_LIMIT_DEFAULT = "8MB"
 
             @staticmethod
             def buffer_byte_limit(p: RaftProperties) -> int:
@@ -180,6 +191,24 @@ class RaftServerConfigKeys:
                 return p.get_int(
                     RaftServerConfigKeys.Log.Appender.PIPELINE_WINDOW_KEY,
                     RaftServerConfigKeys.Log.Appender.PIPELINE_WINDOW_DEFAULT)
+
+            @staticmethod
+            def coalescing_enabled(p: RaftProperties) -> bool:
+                return p.get_boolean(
+                    RaftServerConfigKeys.Log.Appender.COALESCING_ENABLED_KEY,
+                    RaftServerConfigKeys.Log.Appender.COALESCING_ENABLED_DEFAULT)
+
+            @staticmethod
+            def envelope_inflight(p: RaftProperties) -> int:
+                return p.get_int(
+                    RaftServerConfigKeys.Log.Appender.ENVELOPE_INFLIGHT_KEY,
+                    RaftServerConfigKeys.Log.Appender.ENVELOPE_INFLIGHT_DEFAULT)
+
+            @staticmethod
+            def envelope_byte_limit(p: RaftProperties) -> int:
+                return p.get_size(
+                    RaftServerConfigKeys.Log.Appender.ENVELOPE_BYTE_LIMIT_KEY,
+                    RaftServerConfigKeys.Log.Appender.ENVELOPE_BYTE_LIMIT_DEFAULT)
 
     class Snapshot:
         AUTO_TRIGGER_ENABLED_KEY = "raft.server.snapshot.auto.trigger.enabled"
@@ -315,27 +344,20 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.LeaderElection.LEADER_STEP_DOWN_WAIT_TIME_DEFAULT)
 
     class Heartbeat:
-        """Multi-raft heartbeat coalescing (no reference analog — removes
-        the reference's O(groups) per-interval heartbeat RPC volume)."""
+        """Multi-raft bulk heartbeats (no reference analog — removes the
+        reference's O(groups) per-interval heartbeat volume): the sweep
+        ships ONE compact BulkHeartbeat per destination server per interval
+        instead of one AppendEntries per (group, follower).  Disabled =
+        unary per-group heartbeats, the reference's cost shape."""
 
         COALESCING_ENABLED_KEY = "raft.tpu.heartbeat.coalescing.enabled"
-        # Opt-in: pays on real multi-host networks where per-RPC framing
-        # dominates; pure overhead on in-process transports.
-        COALESCING_ENABLED_DEFAULT = False
-        COALESCING_WINDOW_KEY = "raft.tpu.heartbeat.coalescing.window"
-        COALESCING_WINDOW_DEFAULT = TimeDuration.millis(5)
+        COALESCING_ENABLED_DEFAULT = True
 
         @staticmethod
         def coalescing_enabled(p: RaftProperties) -> bool:
             return p.get_boolean(
                 RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY,
                 RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_DEFAULT)
-
-        @staticmethod
-        def coalescing_window(p: RaftProperties) -> TimeDuration:
-            return p.get_time_duration(
-                RaftServerConfigKeys.Heartbeat.COALESCING_WINDOW_KEY,
-                RaftServerConfigKeys.Heartbeat.COALESCING_WINDOW_DEFAULT)
 
     class PauseMonitor:
         """Event-loop pause monitor (reference JvmPauseMonitor.java:38)."""

@@ -202,11 +202,16 @@ class QuorumEngine:
             self._task = None
 
     async def _run(self) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         while self._running:
             if self._wake.is_set():
                 # busy: events already queued — tick now, skip the timer
-                # allocation wait_for would make (hot at high group counts)
+                # allocation wait_for would make (hot at high group counts).
+                # NOTE: pacing dispatches at a tick_interval floor was tried
+                # here and measured ~2.5x WORSE end-to-end at 1024 groups:
+                # commit latency compounds through the sequential per-group
+                # write pipelines, so ticking at the front of the loop
+                # backlog beats amortizing dispatch overhead.
                 await asyncio.sleep(0)
             else:
                 try:

@@ -11,6 +11,7 @@ messages.
 from __future__ import annotations
 
 import dataclasses
+import typing
 import uuid
 
 
@@ -54,6 +55,26 @@ class RaftId:
 class RaftGroupId(RaftId):
     """Identifies one Raft group hosted by a (multi-Raft) server."""
 
+    # Wire decode interning: every RPC header carries a group id, and a
+    # multi-raft server decodes thousands per second — the UUID-object
+    # construction cost shows up in profiles.  Bounded: ids arrive off the
+    # wire BEFORE membership validation, so an unbounded cache would let a
+    # buggy/malicious peer grow process memory with novel ids; past the cap
+    # we simply stop caching (construction still works, just uncached).
+    _intern: dict = {}
+    _INTERN_MAX = 1 << 17
+
+    @classmethod
+    def value_of(cls, value):
+        if isinstance(value, bytes):
+            cached = cls._intern.get(value)
+            if cached is None:
+                cached = cls(uuid.UUID(bytes=value))
+                if len(cls._intern) < cls._INTERN_MAX:
+                    cls._intern[value] = cached
+            return cached
+        return super().value_of(value)
+
     def __str__(self) -> str:  # group-<uuid> like the reference's display form
         return f"group-{self.shorten()}"
 
@@ -69,13 +90,22 @@ class RaftPeerId:
 
     id: str
 
+    # peer ids are few; bounded decode interning (see RaftGroupId)
+    _intern: typing.ClassVar[dict] = {}
+    _INTERN_MAX: typing.ClassVar[int] = 1 << 17
+
     @staticmethod
     def value_of(value: "str | bytes | RaftPeerId") -> "RaftPeerId":
         if isinstance(value, RaftPeerId):
             return value
         if isinstance(value, bytes):
-            return RaftPeerId(value.decode("utf-8"))
-        return RaftPeerId(value)
+            value = value.decode("utf-8")
+        cached = RaftPeerId._intern.get(value)
+        if cached is None:
+            cached = RaftPeerId(value)
+            if len(RaftPeerId._intern) < RaftPeerId._INTERN_MAX:
+                RaftPeerId._intern[value] = cached
+        return cached
 
     def to_bytes(self) -> bytes:
         return self.id.encode("utf-8")

@@ -302,15 +302,18 @@ class StartLeaderElectionReply:
 
 
 @dataclasses.dataclass(frozen=True)
-class CoalescedHeartbeat:
-    """Multi-raft heartbeat envelope: heartbeats from EVERY group a server
-    leads toward one destination server, folded into a single RPC.
+class AppendEnvelope:
+    """Multi-raft AppendEntries envelope: append traffic from EVERY group a
+    server leads toward one destination server, folded into a single RPC —
+    both idle heartbeats and pipelined entry batches.
 
-    No reference analog — the reference sends one heartbeat per group per
-    follower per interval (GrpcLogAppender heartbeat channel), which is the
-    O(groups) idle-RPC wall this framework's multi-raft axis removes.  The
-    envelope carries ordinary AppendEntriesRequests, so each group's
-    semantics are exactly the unary path's."""
+    No reference analog — the reference runs one stream per (group,
+    follower) (GrpcLogAppender.java:356) plus one heartbeat per group per
+    interval, which is the O(groups) RPC wall this framework's multi-raft
+    axis removes.  The envelope carries ordinary AppendEntriesRequests, so
+    each group's semantics are exactly the unary path's; the receiver
+    processes a group's items sequentially in order (RaftServer
+    _handle_append_envelope), which preserves per-group FIFO."""
 
     items: tuple[AppendEntriesRequest, ...]
 
@@ -318,13 +321,13 @@ class CoalescedHeartbeat:
         return {"i": [r.to_dict() for r in self.items]}
 
     @staticmethod
-    def from_dict(d: dict) -> "CoalescedHeartbeat":
-        return CoalescedHeartbeat(
+    def from_dict(d: dict) -> "AppendEnvelope":
+        return AppendEnvelope(
             tuple(AppendEntriesRequest.from_dict(x) for x in d["i"]))
 
 
 @dataclasses.dataclass(frozen=True)
-class CoalescedHeartbeatReply:
+class AppendEnvelopeReply:
     """Per-item replies; None where the peer failed that group (e.g. it does
     not serve it) — the sender treats those as per-follower RPC errors."""
 
@@ -335,10 +338,68 @@ class CoalescedHeartbeatReply:
                       for r in self.items]}
 
     @staticmethod
-    def from_dict(d: dict) -> "CoalescedHeartbeatReply":
-        return CoalescedHeartbeatReply(
+    def from_dict(d: dict) -> "AppendEnvelopeReply":
+        return AppendEnvelopeReply(
             tuple(None if x is None else AppendEntriesReply.from_dict(x)
                   for x in d["i"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class BulkHeartbeat:
+    """Compact multi-raft heartbeat: ONE small message per server pair per
+    interval carrying a fixed-width tuple per led group, instead of one full
+    AppendEntries per (group, follower).
+
+    No reference analog — the reference's per-group heartbeat volume
+    (GrpcLogAppender heartbeat channel) is an O(groups) event-loop wall at
+    thousands of co-hosted groups even when the RPCs are folded, because
+    each heartbeat still costs a full AppendEntries build + handle + reply.
+    The bulk item carries exactly what the idle happy path needs: leadership
+    assertion (term), and safe commit propagation (leader commit + the term
+    of the entry at that index, so the follower advances commit only when
+    its own entry matches — the Log Matching property makes that
+    sufficient).  Any anomaly (behind follower, term conflict) falls back to
+    a full AppendEntries probe on the data path, with prev-check fidelity.
+
+    items: (group_id_bytes, leader_term, leader_commit, commit_entry_term)
+    """
+
+    requestor_id: RaftPeerId
+    reply_id: RaftPeerId
+    items: tuple[tuple[bytes, int, int, int], ...]
+
+    def to_dict(self) -> dict:
+        return {"rq": self.requestor_id.id, "rp": self.reply_id.id,
+                "i": [list(x) for x in self.items]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "BulkHeartbeat":
+        return BulkHeartbeat(RaftPeerId.value_of(d["rq"]),
+                             RaftPeerId.value_of(d["rp"]),
+                             tuple(tuple(x) for x in d["i"]))
+
+
+# BulkHeartbeatReply item result codes
+BULK_HB_OK = 0
+BULK_HB_NOT_LEADER = 1
+BULK_HB_UNKNOWN_GROUP = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class BulkHeartbeatReply:
+    """Aligned 1:1 with the request's items.
+
+    items: (result_code, term, next_index, follower_commit, flush_index)
+    """
+
+    items: tuple[tuple[int, int, int, int, int], ...]
+
+    def to_dict(self) -> dict:
+        return {"i": [list(x) for x in self.items]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "BulkHeartbeatReply":
+        return BulkHeartbeatReply(tuple(tuple(x) for x in d["i"]))
 
 
 # --- generic envelope for transports ---------------------------------------
@@ -349,7 +410,8 @@ _MSG_TYPES: dict[str, type] = {
     "snap_req": InstallSnapshotRequest, "snap_rep": InstallSnapshotReply,
     "readidx_req": ReadIndexRequest, "readidx_rep": ReadIndexReply,
     "sle_req": StartLeaderElectionRequest, "sle_rep": StartLeaderElectionReply,
-    "hb_batch_req": CoalescedHeartbeat, "hb_batch_rep": CoalescedHeartbeatReply,
+    "env_req": AppendEnvelope, "env_rep": AppendEnvelopeReply,
+    "bulkhb_req": BulkHeartbeat, "bulkhb_rep": BulkHeartbeatReply,
 }
 _TYPE_TAGS = {v: k for k, v in _MSG_TYPES.items()}
 

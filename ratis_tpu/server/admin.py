@@ -49,7 +49,7 @@ class PendingReconf:
     joint_index: int = -1
     final_index: int = -1
     future: asyncio.Future = dataclasses.field(
-        default_factory=lambda: asyncio.get_event_loop().create_future())
+        default_factory=lambda: asyncio.get_running_loop().create_future())
 
     def __post_init__(self):
         # The waiter may have timed out before a late failure is recorded;
@@ -179,7 +179,7 @@ async def _wait_caught_up(div, peers: list[RaftPeer], timeout_s: float) -> None:
     gap = div.server.properties.get_int(
         RaftServerConfigKeys.STAGING_CATCHUP_GAP_KEY,
         RaftServerConfigKeys.STAGING_CATCHUP_GAP_DEFAULT)
-    deadline = asyncio.get_event_loop().time() + max(timeout_s, 1.0)
+    deadline = asyncio.get_running_loop().time() + max(timeout_s, 1.0)
     while True:
         if not div.is_leader() or div.leader_ctx is None:
             raise RaftException("lost leadership during staging")
@@ -192,7 +192,7 @@ async def _wait_caught_up(div, peers: list[RaftPeer], timeout_s: float) -> None:
                 break
         if ok:
             return
-        if asyncio.get_event_loop().time() >= deadline:
+        if asyncio.get_running_loop().time() >= deadline:
             raise RaftException(
                 f"staging timeout: new peers not caught up within {timeout_s}s")
         await asyncio.sleep(0.02)
@@ -236,7 +236,7 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
         target_id = target.id
 
     timeout_s = max(args.timeout_ms / 1000.0, 0.2)
-    deadline = asyncio.get_event_loop().time() + timeout_s
+    deadline = asyncio.get_running_loop().time() + timeout_s
     div.stepping_down = True
     try:
         # 1. wait for the target to be fully caught up (match == our last);
@@ -244,7 +244,7 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
         # 3. succeed only once the TARGET is the known leader (reference
         #    TransferLeadership completes on the matching leader event).
         last_sent = -1.0
-        while asyncio.get_event_loop().time() < deadline:
+        while asyncio.get_running_loop().time() < deadline:
             if not div.is_leader():
                 if div.state.leader_id == target_id:
                     return RaftClientReply.success_reply(req)
@@ -253,7 +253,7 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
             ctx = div.leader_ctx
             f = ctx.followers.get(target_id) if ctx is not None else None
             last = state.log.next_index - 1
-            now = asyncio.get_event_loop().time()
+            now = asyncio.get_running_loop().time()
             if f is not None and f.match_index >= last \
                     and now - last_sent > 0.3:
                 last_sent = now

@@ -73,6 +73,12 @@ class Division:
                                  metadata_io=metadata_io)
         self.state_machine = state_machine
         state_machine.member_id = self.member_id
+        # Per-entry SM notification is only dispatched when the app actually
+        # overrides it — a no-op coroutine per applied entry is real cost at
+        # thousands of groups (StateMachine.notifyTermIndexUpdated analog).
+        self._sm_wants_term_index = (
+            type(state_machine).notify_term_index_updated
+            is not StateMachine.notify_term_index_updated)
 
         me = group.get_peer(server.peer_id)
         self.role: RaftPeerRole = (RaftPeerRole.LISTENER
@@ -357,7 +363,7 @@ class Division:
 
     async def start(self) -> None:
         self._running = True
-        self._started_at_s = asyncio.get_event_loop().time()
+        self._started_at_s = asyncio.get_running_loop().time()
         for key in self._reconfigurable_keys():
             self.server.reconfiguration.register(
                 key, self._apply_reconfiguration)
@@ -489,7 +495,7 @@ class Division:
         once per timeout period."""
         if self._no_leader_timeout_s <= 0:
             return
-        now = asyncio.get_event_loop().time()
+        now = asyncio.get_running_loop().time()
         base = max(self._last_heard_leader_s, self._started_at_s)
         if now - base < self._no_leader_timeout_s:
             return
@@ -646,7 +652,7 @@ class Division:
 
         # Leader stickiness: deny if we recently heard from a live leader
         # (reference VoteContext lease check) — applies to both phases.
-        loop_now = asyncio.get_event_loop().time()
+        loop_now = asyncio.get_running_loop().time()
         has_live_leader = (state.leader_id is not None
                            and state.leader_id != candidate
                            and (loop_now - self._last_heard_leader_s)
@@ -701,7 +707,7 @@ class Division:
             await self.change_to_follower(req.leader_term,
                                           req.header.requestor_id,
                                           reason="append from leader")
-        self._last_heard_leader_s = asyncio.get_event_loop().time()
+        self._last_heard_leader_s = asyncio.get_running_loop().time()
         self.reset_election_deadline()
         for pid, idx in req.commit_infos:
             self.update_commit_info(RaftPeerId.value_of(pid), idx)
@@ -743,6 +749,38 @@ class Division:
 
         return reply(AppendResult.SUCCESS, log.next_index)
 
+    async def on_bulk_heartbeat(self, leader_id: RaftPeerId, term: int,
+                                leader_commit: int, commit_term: int
+                                ) -> tuple[int, int, int, int, int]:
+        """One compact heartbeat item (protocol.raftrpc.BulkHeartbeat): the
+        idle happy path of handle_append_entries without request building —
+        leadership recognition, election-deadline reset, and commit advance
+        gated on the Log Matching property (our entry at leader_commit must
+        carry commit_term; identical (term, index) implies an identical
+        prefix, so committing up to it is exactly as safe as the prev-check
+        path).  Anything this cannot verify is left to the full
+        AppendEntries probe the leader falls back to."""
+        from ratis_tpu.protocol.raftrpc import BULK_HB_NOT_LEADER, BULK_HB_OK
+        state = self.state
+        log = state.log
+        if term < state.current_term:
+            return (BULK_HB_NOT_LEADER, state.current_term, log.next_index,
+                    log.get_last_committed_index(), log.flush_index)
+        if term > state.current_term or not self.is_follower() \
+                or state.leader_id != leader_id:
+            await self.change_to_follower(term, leader_id,
+                                          reason="bulk heartbeat from leader")
+        self._last_heard_leader_s = asyncio.get_running_loop().time()
+        self.reset_election_deadline()
+        if commit_term > 0 and leader_commit > log.get_last_committed_index():
+            ti = log.get_term_index(leader_commit)
+            if ti is not None and ti.term == commit_term:
+                commit = min(leader_commit, log.flush_index)
+                if log.update_commit_index(commit, state.current_term, False):
+                    self._apply_wake.set()
+        return (BULK_HB_OK, state.current_term, log.next_index,
+                log.get_last_committed_index(), log.flush_index)
+
     async def handle_install_snapshot(self, req):
         """Follower side of snapshot install: chunked file mode or
         notification mode (SnapshotInstallationHandler.java:60)."""
@@ -764,7 +802,7 @@ class Division:
             await self.change_to_follower(req.leader_term,
                                           req.header.requestor_id,
                                           reason="install snapshot from leader")
-        self._last_heard_leader_s = asyncio.get_event_loop().time()
+        self._last_heard_leader_s = asyncio.get_running_loop().time()
         self.reset_election_deadline()
 
         if req.is_notification():
@@ -934,7 +972,7 @@ class Division:
         conf = self.state.configuration
         if conf.is_transitional():
             return
-        now = asyncio.get_event_loop().time()
+        now = asyncio.get_running_loop().time()
         if now - self._last_yield_attempt_s < self._timeout_min_s:
             return  # give the previous forced election a round to land
         last = self.state.log.next_index - 1
@@ -1240,9 +1278,9 @@ class Division:
                                       name=str(req.client_id),
                                       on_drop=self._on_window_drop)
             self._client_windows[cid] = win
-        win.last_used = asyncio.get_event_loop().time()
+        win.last_used = asyncio.get_running_loop().time()
         self._sweep_client_windows()
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         accepted = await win.receive(req.slider_seq_num, req.slider_first,
                                      (req, fut))
         if not accepted:
@@ -1256,7 +1294,7 @@ class Division:
         stream; with per-request transports we expire instead."""
         if len(self._client_windows) <= 256:
             return
-        now = asyncio.get_event_loop().time()
+        now = asyncio.get_running_loop().time()
         for cid, win in list(self._client_windows.items()):
             if win.pending_count() == 0 \
                     and now - getattr(win, "last_used", 0.0) > 120.0:
@@ -1267,7 +1305,7 @@ class Division:
         (releasing the next seqNum) as soon as this request has been
         appended to the log — commit/apply completes the reply later."""
         req, fut = item
-        submitted = asyncio.get_event_loop().create_future()
+        submitted = asyncio.get_running_loop().create_future()
 
         def on_submitted() -> None:
             if not submitted.done():
@@ -1778,7 +1816,8 @@ class Division:
             await sm.notify_configuration_changed(
                 entry.term, entry.index, self.state.configuration)
             await self._on_conf_entry_applied(entry)
-        await sm.notify_term_index_updated(entry.term, entry.index)
+        if self._sm_wants_term_index:
+            await sm.notify_term_index_updated(entry.term, entry.index)
 
         if self.is_leader() and self.leader_ctx is not None:
             pending = self.leader_ctx.pending.pop(entry.index)

@@ -153,12 +153,12 @@ class LeaderElection:
                 await queue.put(e)
 
         tasks = [asyncio.create_task(_one(p)) for p in others]
-        deadline = asyncio.get_event_loop().time() + div.random_election_timeout_s()
+        deadline = asyncio.get_running_loop().time() + div.random_election_timeout_s()
         outstanding = len(others)
         replied: set = set()
         try:
             while outstanding > 0 and not self._stopped:
-                wait = deadline - asyncio.get_event_loop().time()
+                wait = deadline - asyncio.get_running_loop().time()
                 if wait <= 0:
                     break
                 try:

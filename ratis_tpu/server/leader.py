@@ -27,6 +27,7 @@ from ratis_tpu.protocol.raftrpc import (AppendEntriesReply,
                                         RaftRpcHeader)
 from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
 from ratis_tpu.protocol.termindex import TermIndex
+from ratis_tpu.server.replication import OutItem
 
 LOG = logging.getLogger(__name__)
 
@@ -35,7 +36,7 @@ class PendingRequest:
     def __init__(self, index: int, request: RaftClientRequest):
         self.index = index
         self.request = request
-        self.future: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.future: asyncio.Future = asyncio.get_running_loop().create_future()
 
     def set_reply(self, reply: RaftClientReply) -> None:
         if not self.future.done():
@@ -111,23 +112,27 @@ class FollowerInfo:
         return False
 
 class LogAppender:
-    """One leader->follower replication driver with a pipelined send window.
+    """One leader->follower replication state machine with a pipelined send
+    window, driven by the server-level PeerSender fabric.
 
     Mirrors the reference GrpcLogAppender (GrpcLogAppender.java:343-381):
     up to ``window_limit`` AppendEntries requests are in flight at once —
     ``follower.next_index`` is the optimistic *send* cursor, advanced when a
     batch is handed to the transport, while ``follower.match_index`` advances
-    only on acks.  Replies may complete out of order.  Per-link FIFO
-    delivery (TCP/simulated transports) keeps the pipeline efficient; it is
-    NOT a correctness requirement: reordered delivery (possible with
-    concurrent unary gRPC handlers) at worst produces a spurious
-    INCONSISTENCY -> window reset + resend, and match only ever advances
-    from per-request-capped SUCCESS confirmations.  A dedicated heartbeat timer
-    (reference's separate heartbeat channel, GrpcLogAppender.java:172) fires
-    outside the window and is never queued behind a full pipeline.  On
-    INCONSISTENCY or an RPC error the window resets: the epoch is bumped so
-    in-flight completions from before the reset are ignored, and the send
-    cursor rewinds (GrpcLogAppender.onError/resetClient:475-530).
+    only on acks.  Unlike the reference there is NO daemon per (group,
+    follower): the appender is passive state; the per-destination PeerSender
+    (ratis_tpu.server.replication) calls :meth:`collect` to drain its window
+    fills into shared multi-group envelopes and dispatches replies back via
+    :meth:`on_send_reply`/:meth:`on_send_error`.  Per-group FIFO holds (see
+    replication module docstring); reordered delivery at worst produces a
+    spurious INCONSISTENCY -> window reset + resend, and match only ever
+    advances from per-request-capped SUCCESS confirmations.  A dedicated
+    heartbeat timer (reference's separate heartbeat channel,
+    GrpcLogAppender.java:172) fires outside the window and is never queued
+    behind a full pipeline.  On INCONSISTENCY or an RPC error the window
+    resets: the epoch is bumped so in-flight completions from before the
+    reset are ignored, and the send cursor rewinds
+    (GrpcLogAppender.onError/resetClient:475-530).
     """
 
     def __init__(self, division, follower: FollowerInfo,
@@ -138,29 +143,31 @@ class LogAppender:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.buffer_byte_limit = buffer_byte_limit
         self.window_limit = max(1, window_limit)
-        self._wake = asyncio.Event()
-        self._task: Optional[asyncio.Task] = None
+        self.sender = division.server.replication.sender_for(follower.peer_id)
         self._running = False
         self._epoch = 0        # bumped on window reset; stale replies ignored
         self._inflight = 0     # pipelined (non-heartbeat) requests outstanding
+        self._busy = False     # items in an in-flight envelope (FIFO latch)
+        self._probe_due = False
         self._last_send_s = 0.0
         self._backoff_until = 0.0
         self._last_error_log_s = 0.0
         self._prefaulting = False
+        self._ci_countdown = 0  # commit-infos piggyback thinning
         self._pending_sends: set[asyncio.Task] = set()
 
     def start(self) -> None:
         self._running = True
-        name = f"appender-{self.division.member_id}-{self.follower.peer_id}"
-        self._task = asyncio.create_task(self._run(), name=name)
+        # Initial empty append: announces leadership and probes the follower
+        # log position right away (the reference appender sends immediately
+        # on start; followers learn leader identity from this probe).
+        self._probe_due = True
+        self.sender.mark(self)
 
     async def stop(self) -> None:
         self._running = False
-        self._wake.set()
+        self.sender.unmark(self)
         tasks = list(self._pending_sends)
-        if self._task is not None:
-            tasks.append(self._task)
-        self._task = None
         self._pending_sends.clear()
         for t in tasks:
             t.cancel()
@@ -171,7 +178,8 @@ class LogAppender:
                 pass
 
     def notify(self) -> None:
-        self._wake.set()
+        if self._running:
+            self.sender.mark(self)
 
     def _build_request(self, next_idx: int, heartbeat: bool = False
                        ) -> Optional[AppendEntriesRequest]:
@@ -195,6 +203,16 @@ class LogAppender:
         else:
             entries = tuple(log.get_entries(next_idx, log.next_index,
                                             self.buffer_byte_limit))
+        # Cluster-wide commit picture piggyback (CommitInfoCache): on every
+        # probe/heartbeat, but only every 8th data batch — the infos are
+        # advisory (commit levels for *_COMMITTED watches and group-info),
+        # and rebuilding + re-parsing them per batch taxed the hot path.
+        self._ci_countdown -= 1
+        if heartbeat or self._ci_countdown <= 0:
+            self._ci_countdown = 8
+            infos = div.get_commit_infos_wire()
+        else:
+            infos = ()
         return AppendEntriesRequest(
             header=RaftRpcHeader(div.member_id.peer_id, self.follower.peer_id,
                                  div.group_id),
@@ -202,8 +220,7 @@ class LogAppender:
             previous=prev,
             entries=entries,
             leader_commit=log.get_last_committed_index(),
-            # cluster-wide commit picture piggyback (CommitInfoCache)
-            commit_infos=div.get_commit_infos_wire(),
+            commit_infos=infos,
         )
 
     # -------------------------------------------------------------- window
@@ -217,7 +234,7 @@ class LogAppender:
         self._inflight = 0
         f = self.follower
         # NB: the rewind target is deliberately NOT floored at log.start_index
-        # — next_index < start_index is exactly what routes _fill_window into
+        # — next_index < start_index is exactly what routes collect() into
         # the snapshot-install path for a follower behind the purged log.
         if rewind_to is not None:
             target = max(rewind_to, 0)
@@ -233,39 +250,113 @@ class LogAppender:
             f.next_index = max(f.match_index + 1, 0)
         if backoff_s > 0:
             self._backoff_until = time.monotonic() + backoff_s
-        self._wake.set()
+        if self._running:
+            self.sender.mark(self)
 
-    def _fill_window(self) -> None:
-        """Issue batches until the window is full or the log is drained."""
+    @staticmethod
+    def _approx_bytes(request) -> int:
+        """Cheap request-size estimate for the envelope byte budget (the
+        exact serialized size was already paid once inside get_entries; do
+        not serialize again here)."""
+        total = 128
+        for e in request.entries:
+            if e.smlog is not None:
+                total += (len(e.smlog.log_data)
+                          + len(e.smlog.sm_data or b"") + 48)
+            else:
+                total += 64
+        return total
+
+    def collect(self, out: list, budget: int) -> int:
+        """Drain this follower's due sends into ``out`` (PeerSender flush):
+        the start probe, then window fills until the window is full, the
+        byte budget is spent, or the log is drained.  Returns the
+        (approximate) bytes added.  The busy latch guarantees a group's
+        items are never split across two racing envelopes."""
         div = self.division
-        log = div.state.log
         f = self.follower
-        while (self._running and div.is_leader()
-               and self._inflight < self.window_limit
-               and not f.snapshot_in_progress):
-            next_idx = f.next_index
-            if next_idx >= log.next_index:
-                return  # fully caught up (at send level)
-            if not log.is_resident(next_idx):
-                # evicted segment: fault it in off-loop, then resume — a
-                # synchronous multi-MB read+decode here would stall every
-                # division's heartbeats and election timers
-                if not self._prefaulting:
-                    self._prefaulting = True
-                    self._spawn(self._prefault(next_idx))
-                return
-            request = self._build_request(next_idx)
-            if request is None:
-                # behind the purged log -> snapshot path, serialized by the
-                # snapshot_in_progress flag inside try_install_snapshot
-                self._spawn(self._install_snapshot())
-                return
-            if not request.entries:
-                return
-            f.next_index = request.entries[-1].index + 1
-            self._inflight += 1
-            self._last_send_s = time.monotonic()
-            self._spawn(self._send(request, self._epoch, pipelined=True))
+        if not self._running or not div.is_leader() or self._busy:
+            return 0
+        now = time.monotonic()
+        if now < self._backoff_until:
+            return 0
+        added = 0
+        # Latch BEFORE anything can be appended to out: if a later fill
+        # iteration raises, already-collected items still ship in this
+        # flush's envelope — without the latch a re-mark could split this
+        # group's items across two racing envelopes, breaking per-group
+        # FIFO.  Un-latch on the no-item path at the end.
+        self._busy = True
+        try:
+            if self._probe_due:
+                probe = self._build_request(f.next_index, heartbeat=True)
+                if probe is not None:
+                    self._probe_due = False
+                    self._last_send_s = now
+                    added += 128
+                    out.append(OutItem(self, probe, self._epoch, False))
+            log = div.state.log
+            while (self._inflight < self.window_limit
+                   and not f.snapshot_in_progress and added <= budget):
+                next_idx = f.next_index
+                if next_idx >= log.next_index:
+                    break  # fully caught up (at send level)
+                if not log.is_resident(next_idx):
+                    # evicted segment: fault it in off-loop, then resume — a
+                    # synchronous multi-MB read+decode here would stall every
+                    # division's heartbeats and election timers
+                    if not self._prefaulting:
+                        self._prefaulting = True
+                        self._spawn(self._prefault(next_idx))
+                    break
+                request = self._build_request(next_idx)
+                if request is None:
+                    # behind the purged log -> snapshot path, serialized by
+                    # the snapshot_in_progress flag in try_install_snapshot
+                    self._spawn(self._install_snapshot())
+                    break
+                if not request.entries:
+                    break
+                f.next_index = request.entries[-1].index + 1
+                self._inflight += 1
+                self._last_send_s = now
+                added += self._approx_bytes(request)
+                out.append(OutItem(self, request, self._epoch, True))
+        finally:
+            if not added:
+                self._busy = False
+        return added
+
+    def envelope_done(self, remark: bool = True) -> None:
+        """The envelope carrying this appender's items completed (all its
+        replies/errors dispatched): release the FIFO latch and re-mark so
+        the next flush refills the window."""
+        self._busy = False
+        if remark and self._running and self.division.is_leader():
+            self.sender.mark(self)
+
+    def on_send_error(self, item, e: Exception) -> None:
+        """An envelope / unary send carrying ``item`` failed."""
+        if item.epoch != self._epoch or not self._running:
+            return
+        # Connection trouble: drop the pipeline, retry after a pause paced
+        # by the heartbeat timer (GrpcLogAppender.onError).  Log
+        # (rate-limited) — a silent persistent error here looks like a
+        # wedged follower with no trace of why.
+        now = time.monotonic()
+        if now - self._last_error_log_s > 2.0:
+            self._last_error_log_s = now
+            LOG.warning("%s -> %s append failed (epoch %d): %s",
+                        self.division.member_id, self.follower.peer_id,
+                        self._epoch, e)
+        self._reset_window(backoff_s=self.heartbeat_interval_s)
+
+    async def on_send_reply(self, item, reply: AppendEntriesReply) -> None:
+        if item.epoch != self._epoch or not self._running:
+            return  # window was reset while this was in flight
+        if item.pipelined:
+            self._inflight -= 1
+        await self._on_reply(item.request, reply, item.epoch)
 
     def _spawn(self, coro) -> None:
         t = asyncio.create_task(coro)
@@ -276,49 +367,91 @@ class LogAppender:
         div = self.division
         handled = await div.try_install_snapshot(self.follower)
         if handled:
-            self._wake.set()
+            self.notify()
 
     async def _prefault(self, index: int) -> None:
         try:
             await asyncio.to_thread(self.division.state.log.prefault, index)
         finally:
             self._prefaulting = False
-        self._wake.set()
+        self.notify()
 
-    async def _send(self, request: AppendEntriesRequest, epoch: int,
-                    pipelined: bool, coalesce: bool = False) -> None:
+    async def _send_heartbeat(self, request: AppendEntriesRequest,
+                              epoch: int) -> None:
+        """The unary dedicated heartbeat channel (reference cost shape,
+        used when bulk-heartbeat coalescing is disabled): outside the
+        PeerSender window, never queued behind a full data pipeline."""
         div = self.division
         try:
-            if coalesce:
-                # multi-raft heartbeat batching: one RPC per destination
-                # server per window, carrying every group's heartbeat
-                reply = await div.server.heartbeats.submit(
-                    self.follower.peer_id, request)
-            else:
-                reply = await div.server.send_server_rpc(
-                    self.follower.peer_id, request)
+            reply = await div.server.send_server_rpc(
+                self.follower.peer_id, request)
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            if epoch == self._epoch and self._running:
-                # Connection trouble: drop the pipeline, retry after a pause
-                # paced by the heartbeat timer (GrpcLogAppender.onError).
-                # Log (rate-limited) — a silent persistent error here looks
-                # like a wedged follower with no trace of why.
-                now = time.monotonic()
-                if now - self._last_error_log_s > 2.0:
-                    self._last_error_log_s = now
-                    LOG.warning("%s -> %s append failed (epoch %d): %s",
-                                self.division.member_id,
-                                self.follower.peer_id, self._epoch, e)
-                self._reset_window(backoff_s=self.heartbeat_interval_s)
+            self.on_send_error(OutItem(self, request, epoch, False), e)
             return
         if epoch != self._epoch or not self._running:
             return  # window was reset while this was in flight
-        if pipelined:
-            self._inflight -= 1
         await self._on_reply(request, reply, epoch)
-        self._wake.set()
+        self.notify()
+
+    def heartbeat_item(self, now: float) -> Optional[tuple]:
+        """Contribute this follower's compact item to the sweep's
+        BulkHeartbeat toward its destination server, or None when not due
+        (recent traffic doubles as a heartbeat, exactly like the unary
+        path).  Also doubles as the periodic fill-retry waker."""
+        div = self.division
+        if not self._running or not div.is_leader():
+            return None
+        self.sender.mark(self)  # periodic fill retry (backoff expiry etc.)
+        div.check_follower_slowness(self.follower)
+        if now - self._last_send_s < self.heartbeat_interval_s * 0.9:
+            return None
+        if now < self._backoff_until or self.follower.snapshot_in_progress:
+            return None
+        log = div.state.log
+        commit = log.get_last_committed_index()
+        cti = log.get_term_index(commit) if commit >= 0 else None
+        self._last_send_s = now
+        return (div.group_id.to_bytes(), div.state.current_term, commit,
+                cti.term if cti is not None else -1)
+
+    async def on_bulk_reply(self, code: int, term: int, next_index: int,
+                            follower_commit: int, flush_index: int) -> None:
+        """Dispatch one aligned BulkHeartbeatReply item.  Happy path keeps
+        the follower fresh (staleness + watch frontiers); any anomaly
+        escalates to a full AppendEntries probe on the data path, which
+        carries the prev check the compact item omits."""
+        from ratis_tpu.protocol.raftrpc import (BULK_HB_OK,
+                                                BULK_HB_UNKNOWN_GROUP)
+        div = self.division
+        if not self._running or not div.is_leader():
+            return
+        if code == BULK_HB_UNKNOWN_GROUP:
+            return  # peer doesn't host this group (e.g. mid group-add)
+        if term > div.state.current_term:
+            await div.change_to_follower(
+                term, None, reason="higher term in bulk heartbeat reply")
+            return
+        if code != BULK_HB_OK:
+            return  # stale NOT_LEADER at <= our term: ignore
+        f = self.follower
+        f.last_rpc_response_s = time.monotonic()
+        if follower_commit > f.commit_index:
+            f.commit_index = follower_commit
+            div.update_commit_info(f.peer_id, follower_commit)
+        div.on_follower_heartbeat_ack(f)
+        log = div.state.log
+        if (next_index < f.next_index and self._inflight == 0
+                and not self._busy):
+            # Follower's log ends before our send cursor with nothing in
+            # flight: it lost entries (restart) or our cursor is stale.
+            # Send a full probe so the INCONSISTENCY path decides with
+            # prev-check fidelity (including the match-regress protocol).
+            self._probe_due = True
+            self.sender.mark(self)
+        elif log.next_index > f.next_index:
+            self.sender.mark(self)  # data pending: wake the fill path
 
     async def _on_reply(self, request: AppendEntriesRequest,
                         reply: AppendEntriesReply, epoch: int) -> None:
@@ -370,44 +503,19 @@ class LogAppender:
             # stale term on our side already handled above; otherwise ignore
             pass
 
-    # --------------------------------------------------------------- loops
-
-    async def _run(self) -> None:
-        div = self.division
-        # Initial empty append: announces leadership and probes the follower
-        # log position right away (the reference appender sends immediately
-        # on start; followers learn leader identity from this probe).
-        probe = self._build_request(self.follower.next_index, heartbeat=True)
-        if probe is not None:
-            self._last_send_s = time.monotonic()
-            self._spawn(self._send(probe, self._epoch, pipelined=False))
-        while self._running and div.is_leader():
-            now = time.monotonic()
-            if now < self._backoff_until:
-                await asyncio.sleep(self._backoff_until - now)
-                continue
-            self._wake.clear()
-            self._fill_window()
-            # Plain wait, no per-iteration wait_for timer: every completion
-            # path sets _wake (replies, errors via window reset, prefaults,
-            # snapshot installs), and the heartbeat loop doubles as the
-            # periodic waker so fills retry at least once per interval.
-            await self._wake.wait()
+    # ----------------------------------------------------------- heartbeats
 
     def on_heartbeat_sweep(self, now: float) -> None:
-        """One iteration of the dedicated heartbeat channel, driven by the
-        SERVER-level sweep (server.HeartbeatScheduler) instead of a task per
-        (division, follower) — at thousands of co-hosted groups, 2G standing
-        timer tasks were the scaling wall, and the sweep phase-aligns all
-        heartbeats toward a destination so coalescing folds them into one
-        RPC.  Semantics match the per-appender loop it replaces: an empty
-        AppendEntries goes out whenever nothing else has been sent for an
-        interval, regardless of window occupancy (GrpcLogAppender.java:172
-        heartbeat stream)."""
+        """One iteration of the unary dedicated heartbeat channel, driven by
+        the SERVER-level sweep (server.HeartbeatScheduler) when bulk
+        coalescing is disabled.  Semantics match the reference's dedicated
+        heartbeat stream: an empty AppendEntries goes out whenever nothing
+        else has been sent for an interval, regardless of window occupancy
+        (GrpcLogAppender.java:172)."""
         div = self.division
         if not self._running or not div.is_leader():
             return
-        self._wake.set()  # periodic fill retry for the main loop
+        self.sender.mark(self)  # periodic fill retry (backoff expiry etc.)
         try:
             div.check_follower_slowness(self.follower)
             if now - self._last_send_s < self.heartbeat_interval_s * 0.9:
@@ -419,10 +527,9 @@ class LogAppender:
             if hb is None:
                 return  # snapshot path owns this follower right now
             self._last_send_s = now
-            self._spawn(self._send(hb, self._epoch, pipelined=False,
-                                   coalesce=div.server.heartbeat_coalescing))
+            self._spawn(self._send_heartbeat(hb, self._epoch))
         except Exception:
-            # the sweep must never die on one follower's error — the wake
+            # the sweep must never die on one follower's error — the mark
             # above already ran, so fills keep retrying regardless
             LOG.exception("%s heartbeat sweep iteration failed",
                           self.division.member_id)
@@ -442,7 +549,7 @@ class LeaderContext:
         self.followers: dict[RaftPeerId, FollowerInfo] = {}
         self.appenders: dict[RaftPeerId, LogAppender] = {}
         self.startup_index: int = -1  # the conf entry appended on election
-        self.leader_ready = asyncio.get_event_loop().create_future()
+        self.leader_ready = asyncio.get_running_loop().create_future()
         # shared with the server-level HeartbeatScheduler sweep — the two
         # cadences must agree or heartbeat gaps silently grow
         self._heartbeat_interval_s = division.server.heartbeat_interval_s

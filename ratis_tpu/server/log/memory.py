@@ -32,6 +32,16 @@ class MemoryRaftLog(RaftLog):
         return self._start
 
     @property
+    def next_index(self) -> int:
+        # O(1) without TermIndex allocation: this is the single hottest log
+        # accessor (appender fills, append handlers, bulk heartbeats)
+        if self._entries:
+            return self._start + len(self._entries)
+        if self._below_start is not None:
+            return self._below_start.index + 1
+        return max(self._start, 0)
+
+    @property
     def flush_index(self) -> int:
         return self.next_index - 1
 

@@ -134,7 +134,7 @@ class LogWorker:
             self.registry_metrics.unregister()
 
     def submit(self, fileobj, data: bytes) -> asyncio.Future:
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._queue.append((fileobj, data, fut))
         if self._wake is not None:
             self._wake.set()

@@ -63,7 +63,7 @@ class PauseMonitor:
             self._task = None
 
     async def _run(self) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         while self._running:
             t0 = loop.time()
             await asyncio.sleep(self.interval_s)

@@ -32,7 +32,7 @@ class AppliedIndexWaiters:
     async def wait_applied(self, index: int, timeout_s: float) -> int:
         if index <= self.applied:
             return self.applied
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._seq += 1
         heapq.heappush(self.heap, (index, self._seq, fut))
         return await asyncio.wait_for(fut, timeout_s)

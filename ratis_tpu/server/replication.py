@@ -1,0 +1,251 @@
+"""Server-level replication fan-out: one sender per destination server.
+
+The reference runs one LogAppender daemon per (group, follower), each with
+its own long-lived stream (ratis-grpc/.../server/GrpcLogAppender.java:70,
+343-381) — O(groups) threads and O(groups) RPC streams toward every peer.
+That cost shape is exactly what caps the multi-raft axis at thousands of
+co-hosted groups.
+
+This module keeps the per-follower window/epoch state machine
+(ratis_tpu.server.leader.LogAppender) but replaces the send fabric: ONE
+PeerSender task per destination server drains every marked appender's
+window fills into a single :class:`AppendEnvelope` RPC per flush (data-path
+coalescing), or into a concurrent burst of unary RPCs when coalescing is
+disabled (the reference's per-group cost shape, kept as the benchmark
+baseline mode).
+
+Ordering: per-group FIFO holds end to end because (a) an appender
+contributes items to at most one in-flight envelope at a time (the
+``collect``/``envelope_done`` busy latch), (b) envelopes carry items in
+collect order, and (c) the receiver (RaftServer._handle_append_envelope)
+processes one group's items sequentially in order.  Reordering across those
+guarantees (e.g. unary mode over a reordering transport) at worst costs a
+spurious INCONSISTENCY + window reset — never safety, because match only
+advances from request-capped SUCCESS confirmations.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import NamedTuple, Optional
+
+from ratis_tpu.protocol.exceptions import TimeoutIOException
+from ratis_tpu.protocol.ids import RaftPeerId
+from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope)
+
+LOG = logging.getLogger(__name__)
+
+
+class OutItem(NamedTuple):
+    """One collected AppendEntries send: who to notify and with which epoch
+    the reply must be matched (stale-epoch replies are dropped by the
+    appender, mirroring GrpcLogAppender's resetClient semantics)."""
+
+    appender: object  # leader.LogAppender
+    request: AppendEntriesRequest
+    epoch: int
+    pipelined: bool
+
+
+class PeerSender:
+    """Drains every co-hosted group's pending append batches toward ONE
+    destination server.
+
+    A flush collects from all marked appenders (round-robin in mark order,
+    bounded by the envelope byte budget) and ships one envelope; up to
+    ``inflight_cap`` envelopes may be in flight so one slow envelope never
+    head-of-line-blocks other groups' batches.  While an envelope is in
+    flight its appenders are latched busy, so a group's entries are never
+    split across two racing envelopes.
+    """
+
+    def __init__(self, server, to: RaftPeerId, *, coalescing: bool,
+                 inflight_cap: int, envelope_byte_limit: int,
+                 metrics: Optional[dict] = None):
+        self.server = server
+        self.to = to
+        self.coalescing = coalescing
+        self.envelope_byte_limit = envelope_byte_limit
+        self.metrics = metrics if metrics is not None else {
+            "envelopes": 0, "items": 0}
+        self._dirty: dict[object, None] = {}  # insertion-ordered appender set
+        self._wake = asyncio.Event()
+        self._slots = asyncio.Semaphore(max(1, inflight_cap))
+        self._running = True
+        self._inflight_tasks: set[asyncio.Task] = set()
+        self._task = asyncio.create_task(
+            self._run(), name=f"sender-{server.peer_id}->{to}")
+
+    # -- intake ---------------------------------------------------------------
+
+    def mark(self, appender) -> None:
+        """Register an appender as having (potential) work toward this
+        destination and wake the flush loop."""
+        self._dirty[appender] = None
+        self._wake.set()
+
+    def unmark(self, appender) -> None:
+        self._dirty.pop(appender, None)
+
+    # -- flush loop -----------------------------------------------------------
+
+    async def _run(self) -> None:
+        server = self.server
+        while self._running:
+            if not self._dirty:
+                self._wake.clear()
+                if not self._dirty:  # re-check: mark may race the clear
+                    await self._wake.wait()
+                # Micro-batch: let the in-progress scheduling burst (many
+                # groups appending in the same loop pass) finish marking
+                # before collecting, so the burst folds into one envelope
+                # instead of a first tiny one + a big one.
+                await asyncio.sleep(0)
+                continue
+            await self._slots.acquire()
+            if not self._running:
+                self._slots.release()
+                return
+            items: list[OutItem] = []
+            budget = self.envelope_byte_limit
+            while self._dirty and budget > 0:
+                a = next(iter(self._dirty))
+                del self._dirty[a]
+                try:
+                    budget -= a.collect(items, budget)
+                except Exception:
+                    LOG.exception("%s->%s collect failed for %s",
+                                  server.peer_id, self.to, a)
+            if not items:
+                self._slots.release()
+                continue
+            self.metrics["envelopes"] += 1
+            self.metrics["items"] += len(items)
+            if self.coalescing:
+                t = asyncio.create_task(self._send(items))
+                self._inflight_tasks.add(t)
+                t.add_done_callback(self._inflight_tasks.discard)
+            else:
+                # Reference cost shape: one independent unary RPC task per
+                # batch, window refilled per reply — NO flush barrier, so
+                # this baseline mode keeps exactly the old per-appender
+                # pipelining behavior (a slow RPC never stalls the rest of
+                # the flush's items, and the benchmark's vs_baseline
+                # compares against an unhandicapped per-group path).
+                for it in items:
+                    it.appender.envelope_done(remark=False)
+                    t = asyncio.create_task(self._send_unary(it))
+                    self._inflight_tasks.add(t)
+                    t.add_done_callback(self._inflight_tasks.discard)
+                self._slots.release()
+
+    async def _send_unary(self, it: OutItem) -> None:
+        """Baseline (coalescing-disabled) path: one RPC per collected batch,
+        reply dispatched independently — the reference's per-(group,
+        follower) send shape."""
+        try:
+            reply = await self.server.send_server_rpc(self.to, it.request)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            it.appender.on_send_error(it, e)
+            return
+        try:
+            await it.appender.on_send_reply(it, reply)
+        except Exception:
+            LOG.exception("%s->%s unary reply dispatch failed",
+                          self.server.peer_id, self.to)
+        finally:
+            it.appender.notify()  # refill the window per reply
+            self._wake.set()
+
+    async def _send(self, items: list[OutItem]) -> None:
+        server = self.server
+        replies: list = []
+        error: Optional[Exception] = None
+        try:
+            if len(items) > 1:
+                env = AppendEnvelope(tuple(it.request for it in items))
+                reply = await server.send_server_rpc(self.to, env)
+                replies = list(reply.items)
+                if len(replies) != len(items):
+                    raise TimeoutIOException("envelope reply length mismatch")
+            else:
+                replies = [await server.send_server_rpc(
+                    self.to, items[0].request)]
+        except asyncio.CancelledError:
+            for it in items:
+                it.appender.envelope_done(remark=False)
+            raise
+        except Exception as e:
+            error = e
+        try:
+            for i, it in enumerate(items):
+                rep = error if error is not None else replies[i]
+                try:
+                    if isinstance(rep, asyncio.CancelledError):
+                        continue
+                    if rep is None:
+                        rep = TimeoutIOException(
+                            f"{self.to} failed this group's append")
+                    if isinstance(rep, Exception):
+                        it.appender.on_send_error(it, rep)
+                    else:
+                        await it.appender.on_send_reply(it, rep)
+                except Exception:
+                    LOG.exception("%s->%s reply dispatch failed",
+                                  server.peer_id, self.to)
+        finally:
+            for a in {it.appender for it in items}:
+                a.envelope_done()
+            self._slots.release()
+            self._wake.set()
+
+    async def close(self) -> None:
+        self._running = False
+        self._wake.set()
+        tasks = [self._task, *self._inflight_tasks]
+        self._inflight_tasks.clear()
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+
+class ReplicationScheduler:
+    """Owns one PeerSender per destination this server replicates toward
+    (created lazily; peers are few even when groups are many)."""
+
+    def __init__(self, server, *, coalescing: bool, inflight_cap: int,
+                 envelope_byte_limit: int):
+        self.server = server
+        self.coalescing = coalescing
+        self.inflight_cap = inflight_cap
+        self.envelope_byte_limit = envelope_byte_limit
+        self._senders: dict[RaftPeerId, PeerSender] = {}
+        self._closed = False
+        # shared across senders: folding evidence for tests/benchmarks
+        self.metrics = {"envelopes": 0, "items": 0}
+
+    def sender_for(self, to: RaftPeerId) -> PeerSender:
+        s = self._senders.get(to)
+        if s is None:
+            if self._closed:
+                raise RuntimeError("replication scheduler closed")
+            s = PeerSender(self.server, to, coalescing=self.coalescing,
+                           inflight_cap=self.inflight_cap,
+                           envelope_byte_limit=self.envelope_byte_limit,
+                           metrics=self.metrics)
+            self._senders[to] = s
+        return s
+
+    async def close(self) -> None:
+        self._closed = True
+        senders = list(self._senders.values())
+        self._senders.clear()
+        for s in senders:
+            await s.close()

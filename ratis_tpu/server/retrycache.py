@@ -25,7 +25,7 @@ CacheKey = tuple[bytes, int]
 class CacheEntry:
     def __init__(self, key: CacheKey):
         self.key = key
-        self.future: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.future: asyncio.Future = asyncio.get_running_loop().create_future()
         self.created = time.monotonic()
 
     @property

@@ -21,9 +21,8 @@ from ratis_tpu.protocol.exceptions import (AlreadyExistsException,
                                            RaftException)
 from ratis_tpu.protocol.group import RaftGroup
 from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
-from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest,
-                                        CoalescedHeartbeat,
-                                        CoalescedHeartbeatReply,
+from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope,
+                                        AppendEnvelopeReply,
                                         InstallSnapshotRequest,
                                         ReadIndexRequest, RequestVoteRequest,
                                         StartLeaderElectionRequest)
@@ -44,17 +43,20 @@ class HeartbeatScheduler:
     """ONE periodic task per server sweeping every leader division's
     appenders (replaces a heartbeat-timer task per (division, follower) —
     2G standing tasks was the multi-raft scaling wall).  Each sweep wakes
-    the appender fill loops, runs slowness detection, and sends any due
-    heartbeats.  With coalescing enabled the sweep's phase alignment lets
-    the HeartbeatCoalescer fold a whole sweep into one RPC per destination;
-    without it, the sweep yields periodically so the burst of individual
-    sends never stalls the event loop."""
+    the appender fill paths, runs slowness detection, and sends any due
+    heartbeats.  With coalescing enabled the sweep collects one COMPACT
+    bulk item per due appender and ships one BulkHeartbeat RPC per
+    destination server (see protocol.raftrpc.BulkHeartbeat — the per-item
+    cost is a few dict lookups, not a full AppendEntries build+handle);
+    without it, each appender sends its own unary AppendEntries heartbeat
+    (the reference's cost shape)."""
 
     def __init__(self, server: "RaftServer", interval_s: float):
         self.server = server
         self.interval_s = interval_s
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        self._sweep_seq = 0
 
     def start(self) -> None:
         self._running = True
@@ -87,18 +89,34 @@ class HeartbeatScheduler:
         while self._running:
             await asyncio.sleep(self.interval_s)
             now = _time.monotonic()
+            self._sweep_seq += 1
+            coalesce = self.server.heartbeat_coalescing
+            # destination -> ([bulk items], [appenders], aligned)
+            bulk: dict[RaftPeerId, tuple[list, list]] = {}
             sweep = 0
-            for div in list(self.server.divisions.values()):
+            for i, div in enumerate(list(self.server.divisions.values())):
                 # One division's failure must never kill the single
                 # server-wide heartbeat task — that silently collapses every
                 # leadership on the server with no recovery path.
                 try:
                     if not div.is_leader() or div.leader_ctx is None:
                         continue
-                    div.check_yield_to_higher_priority()
+                    if (self._sweep_seq + i) % 4 == 0:
+                        # priority-yield scan is O(followers) python; its
+                        # urgency is seconds, so a quarter-rate phase-spread
+                        # scan keeps the sweep cheap at thousands of leaders
+                        div.check_yield_to_higher_priority()
                     for appender in list(div.leader_ctx.appenders.values()):
-                        appender.on_heartbeat_sweep(now)
                         sweep += 1
+                        if coalesce:
+                            item = appender.heartbeat_item(now)
+                            if item is not None:
+                                b = bulk.setdefault(
+                                    appender.follower.peer_id, ([], []))
+                                b[0].append(item)
+                                b[1].append(appender)
+                        else:
+                            appender.on_heartbeat_sweep(now)
                         if sweep % 256 == 0:
                             # don't stall the loop for one giant synchronous
                             # burst at thousands of co-hosted leaders
@@ -108,86 +126,59 @@ class HeartbeatScheduler:
                 except Exception:
                     LOG.exception("heartbeat sweep failed for %s",
                                   div.member_id)
+            for to, (items, appenders) in bulk.items():
+                self.server.heartbeats.submit(to, items, appenders)
 
 
-class HeartbeatCoalescer:
-    """Folds heartbeats from every co-hosted group toward one destination
-    server into a single RPC per flush window.
+class BulkHeartbeatService:
+    """Sends one BulkHeartbeat per destination server per sweep and routes
+    the aligned per-item replies back to their appenders.  A failed send is
+    simply dropped — the next sweep retries, and persistent failure
+    surfaces through leadership staleness (no acks) exactly like a dead
+    unary heartbeat channel would."""
 
-    The reference sends one heartbeat per group per follower per interval
-    (GrpcLogAppender's heartbeat channel) — an O(groups) idle-RPC wall on
-    the multi-raft axis this server removes: appenders submit their built
-    AppendEntries heartbeat here and a short window (default 5ms) batches
-    everything bound for the same peer into one CoalescedHeartbeat
-    envelope.  Reply handling, epochs and slowness detection stay entirely
-    in the per-follower appender; only the transport round trips change."""
-
-    def __init__(self, server: "RaftServer", window_s: float = 0.005):
+    def __init__(self, server: "RaftServer"):
         self.server = server
-        self.window_s = window_s
-        self._queues: dict[RaftPeerId, list] = {}
-        self._flushers: dict[RaftPeerId, asyncio.Task] = {}
         self.metrics = {"batches": 0, "heartbeats": 0}
+        self._pending: set[asyncio.Task] = set()
 
-    def submit(self, to: RaftPeerId, request) -> "asyncio.Future":
-        """Queue one group's heartbeat to ``to``; resolves with its
-        AppendEntriesReply (or raises like a failed unary RPC)."""
-        fut = asyncio.get_event_loop().create_future()
-        self._queues.setdefault(to, []).append((request, fut))
-        if to not in self._flushers:
-            self._flushers[to] = asyncio.create_task(self._flush(to))
-        return fut
+    def submit(self, to: RaftPeerId, items: list, appenders: list) -> None:
+        t = asyncio.create_task(self._send(to, items, appenders))
+        self._pending.add(t)
+        t.add_done_callback(self._pending.discard)
 
-    async def _flush(self, to: RaftPeerId) -> None:
-        from ratis_tpu.protocol.exceptions import TimeoutIOException
-        try:
-            await asyncio.sleep(self.window_s)
-        finally:
-            self._flushers.pop(to, None)
-        batch = self._queues.pop(to, [])
-        if not batch:
-            return
+    async def _send(self, to: RaftPeerId, items: list, appenders: list) -> None:
+        from ratis_tpu.protocol.raftrpc import BulkHeartbeat
         self.metrics["batches"] += 1
-        self.metrics["heartbeats"] += len(batch)
+        self.metrics["heartbeats"] += len(items)
         try:
             reply = await self.server.send_server_rpc(
-                to, CoalescedHeartbeat(tuple(r for r, _ in batch)))
-            items = reply.items
-            if len(items) != len(batch):
-                raise TimeoutIOException("coalesced reply length mismatch")
+                to, BulkHeartbeat(self.server.peer_id, to, tuple(items)))
         except asyncio.CancelledError:
-            self._fail(batch, "coalescer closing")
             raise
-        except Exception as e:
-            self._fail(batch, str(e))
+        except Exception:
+            return  # next sweep retries; staleness covers persistent failure
+        if len(reply.items) != len(items):
+            LOG.warning("%s: bulk heartbeat reply misaligned from %s",
+                        self.server.peer_id, to)
             return
-        for (_, fut), item in zip(batch, items):
-            if fut.done():
-                continue
-            if item is None:
-                fut.set_exception(TimeoutIOException(
-                    f"{to} failed this group's heartbeat"))
-            else:
-                fut.set_result(item)
-
-    def _fail(self, batch, reason: str) -> None:
-        from ratis_tpu.protocol.exceptions import TimeoutIOException
-        for _, fut in batch:
-            if not fut.done():
-                fut.set_exception(
-                    TimeoutIOException(f"coalesced heartbeat: {reason}"))
+        for appender, item in zip(appenders, reply.items):
+            try:
+                await appender.on_bulk_reply(*item)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                LOG.exception("%s bulk heartbeat reply dispatch failed",
+                              self.server.peer_id)
 
     async def close(self) -> None:
-        for task in list(self._flushers.values()):
+        for task in list(self._pending):
             task.cancel()
             try:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
-        self._flushers.clear()
-        for to, batch in self._queues.items():
-            self._fail(batch, "server closing")
-        self._queues.clear()
+        self._pending.clear()
 
 
 class RaftServer:
@@ -223,10 +214,18 @@ class RaftServer:
         from ratis_tpu.conf.reconfiguration import ReconfigurationManager
         # live property reconfiguration (divisions register their knobs)
         self.reconfiguration = ReconfigurationManager(properties)
-        self.heartbeats = HeartbeatCoalescer(
-            self, RaftServerConfigKeys.Heartbeat.coalescing_window(p).seconds)
+        self.heartbeats = BulkHeartbeatService(self)
         self.heartbeat_coalescing = \
             RaftServerConfigKeys.Heartbeat.coalescing_enabled(p)
+        # Data-path fan-out: one PeerSender per destination server drains
+        # every group's append batches (ratis_tpu.server.replication).
+        from ratis_tpu.server.replication import ReplicationScheduler
+        appender_keys = RaftServerConfigKeys.Log.Appender
+        self.replication = ReplicationScheduler(
+            self,
+            coalescing=appender_keys.coalescing_enabled(p),
+            inflight_cap=appender_keys.envelope_inflight(p),
+            envelope_byte_limit=appender_keys.envelope_byte_limit(p))
         # single source of truth for the heartbeat cadence (LeaderContext
         # and the sweep must agree, or heartbeat gaps silently grow)
         self.heartbeat_interval_s = \
@@ -333,6 +332,7 @@ class RaftServer:
         # after divisions: a live leader appender could otherwise submit a
         # heartbeat that recreates a flusher task in a closed coalescer
         await self.heartbeats.close()
+        await self.replication.close()
         await self.engine.close()
         self.life_cycle.transition(LifeCycleState.CLOSED)
 
@@ -427,8 +427,11 @@ class RaftServer:
     # ------------------------------------------------------------- routing
 
     async def _handle_server_rpc(self, msg):
-        if isinstance(msg, CoalescedHeartbeat):
-            return await self._handle_coalesced_heartbeat(msg)
+        from ratis_tpu.protocol.raftrpc import BulkHeartbeat
+        if isinstance(msg, AppendEnvelope):
+            return await self._handle_append_envelope(msg)
+        if isinstance(msg, BulkHeartbeat):
+            return await self._handle_bulk_heartbeat(msg)
         div = self.get_division(msg.header.group_id)
         if isinstance(msg, AppendEntriesRequest):
             return await div.handle_append_entries(msg)
@@ -442,22 +445,58 @@ class RaftServer:
             return await div.handle_start_leader_election(msg)
         raise RaftException(f"unknown server rpc {type(msg).__name__}")
 
-    async def _handle_coalesced_heartbeat(self, env: CoalescedHeartbeat
-                                          ) -> CoalescedHeartbeatReply:
-        """Fan a heartbeat envelope out to its divisions; groups are
-        independent, so handling is concurrent (each division's append lock
-        still serializes within the group).  A group this server doesn't
-        host yields None — a per-group error, not an envelope failure."""
+    async def _handle_append_envelope(self, env: AppendEnvelope
+                                      ) -> AppendEnvelopeReply:
+        """Fan an append envelope (coalesced data batches and/or heartbeats)
+        out to its divisions.  Groups are independent, so distinct groups are
+        handled concurrently; one group's items are handled sequentially in
+        envelope order, which — with the sender's one-envelope-per-appender
+        latch — preserves per-group FIFO end to end.  A group this server
+        doesn't host yields None — a per-group error, not an envelope
+        failure."""
+        items = env.items
+        results: list = [None] * len(items)
+        by_group: dict = {}
+        for i, req in enumerate(items):
+            by_group.setdefault(req.header.group_id, []).append(i)
 
-        async def one(req):
-            try:
-                div = self.get_division(req.header.group_id)
-                return await div.handle_append_entries(req)
-            except Exception:
-                return None
+        async def run_group(idxs):
+            for i in idxs:
+                try:
+                    div = self.get_division(items[i].header.group_id)
+                    results[i] = await div.handle_append_entries(items[i])
+                except Exception:
+                    results[i] = None
 
-        items = await asyncio.gather(*(one(r) for r in env.items))
-        return CoalescedHeartbeatReply(tuple(items))
+        await asyncio.gather(*(run_group(ix) for ix in by_group.values()))
+        return AppendEnvelopeReply(tuple(results))
+
+    async def _handle_bulk_heartbeat(self, msg):
+        """Follower side of the compact multi-group heartbeat: one small
+        per-division happy-path step per item (leadership recognition +
+        deadline reset + log-matching-gated commit advance), sequential with
+        periodic yields.  Groups this server doesn't host reply
+        UNKNOWN_GROUP."""
+        from ratis_tpu.protocol.ids import RaftGroupId
+        from ratis_tpu.protocol.raftrpc import (BULK_HB_UNKNOWN_GROUP,
+                                                BulkHeartbeatReply)
+        src = msg.requestor_id
+        results = []
+        for n, (gid_bytes, term, commit, commit_term) in enumerate(msg.items):
+            div = self.divisions.get(RaftGroupId.value_of(gid_bytes))
+            if div is None:
+                results.append((BULK_HB_UNKNOWN_GROUP, -1, -1, -1, -1))
+            else:
+                try:
+                    results.append(await div.on_bulk_heartbeat(
+                        src, term, commit, commit_term))
+                except Exception:
+                    LOG.exception("%s bulk heartbeat item failed",
+                                  self.peer_id)
+                    results.append((BULK_HB_UNKNOWN_GROUP, -1, -1, -1, -1))
+            if (n + 1) % 256 == 0:
+                await asyncio.sleep(0)
+        return BulkHeartbeatReply(tuple(results))
 
     async def _handle_client_request(self, request: RaftClientRequest
                                      ) -> RaftClientReply:

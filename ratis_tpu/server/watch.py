@@ -34,7 +34,7 @@ class _Queue:
         self.frontier = -1
 
     def add(self, index: int) -> asyncio.Future:
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         if index <= self.frontier:
             fut.set_result(self.frontier)
             return fut

@@ -50,12 +50,20 @@ def bench_properties(batched: bool, num_groups: int = 1) -> RaftProperties:
           str(max(QuorumEngine._bucket(num_groups), 64)))
     RaftServerConfigKeys.Log.set_use_memory(p, True)
     if batched:
-        # every tick runs the jitted kernel over all groups (the TPU-native
-        # execution mode); otherwise the per-group scalar fallback runs —
-        # the reference's cost shape (one Python pass per group per event).
+        # TPU-native execution mode: every tick runs the jitted kernel over
+        # all groups, and append traffic toward each destination server is
+        # folded into multi-group envelopes (data-path + heartbeat
+        # coalescing — O(server pairs) RPCs instead of O(groups)).
         p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
+        p.set(RaftServerConfigKeys.Log.Appender.COALESCING_ENABLED_KEY, "true")
+        p.set(RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY, "true")
     else:
+        # the reference's cost shape: one Python pass per group per event
+        # (thread-per-division EventProcessor analog) and one RPC per
+        # (group, follower) batch (GrpcLogAppender.java:356 stream-per-pair).
         p.set("raft.tpu.engine.scalar-fallback-threshold", "1000000000")
+        p.set(RaftServerConfigKeys.Log.Appender.COALESCING_ENABLED_KEY, "false")
+        p.set(RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY, "false")
     return p
 
 

@@ -108,10 +108,10 @@ class MembershipCluster:
         # can land before a bootstrapped newcomer finishes creating its
         # division, and a client could otherwise pick it and get
         # GroupMismatch
-        deadline = asyncio.get_event_loop().time() + 10.0
+        deadline = asyncio.get_running_loop().time() + 10.0
         while any(self.group_id not in self.servers[p].divisions
                   for p in target):
-            if asyncio.get_event_loop().time() > deadline:
+            if asyncio.get_running_loop().time() > deadline:
                 raise RuntimeError("new members did not join in time")
             await asyncio.sleep(0.05)
         for port in current - target:

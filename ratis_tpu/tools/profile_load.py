@@ -1,0 +1,52 @@
+"""Dev tool: cProfile the bench load phase at N groups (not part of the
+framework; run as `python tools_profile_load.py [groups] [batched]`)."""
+import asyncio
+import cProfile
+import io
+import json
+import pstats
+import sys
+
+
+def _force_cpu_platform():
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    _force_cpu_platform()
+    groups = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    batched = (sys.argv[2] != "scalar") if len(sys.argv) > 2 else True
+    from ratis_tpu.tools.bench_cluster import BenchCluster
+
+    async def run():
+        cluster = BenchCluster(groups, batched=batched)
+        try:
+            await cluster.start()
+            await cluster.run_load(1, 128)  # warmup
+            prof = cProfile.Profile()
+            prof.enable()
+            result = await cluster.run_load(8, 128)
+            prof.disable()
+            print("RESULT " + json.dumps(result))
+            s = io.StringIO()
+            ps = pstats.Stats(prof, stream=s).sort_stats("cumulative")
+            ps.print_stats(45)
+            print(s.getvalue())
+            s = io.StringIO()
+            ps = pstats.Stats(prof, stream=s).sort_stats("tottime")
+            ps.print_stats(35)
+            print(s.getvalue())
+        finally:
+            await cluster.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -227,7 +227,7 @@ class DataStreamConnection:
         if key in self._pending:
             raise ConnectionError(
                 f"duplicate in-flight packet key {key} (zero-length data?)")
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._pending[key] = fut
         async with self._send_lock:
             self._writer.write(encode_packet(packet))

@@ -196,7 +196,7 @@ class _AppendStreamClient:
             raise TimeoutIOException("append stream closed")
         call_id = self._next_id
         self._next_id += 1
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
 
         async def _write_then_wait() -> bytes:

@@ -100,7 +100,7 @@ class SimulatedNetwork:
         if link is None:
             await asyncio.sleep(d / 1e3)
             return
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         now = loop.time()
         at = max(now + d / 1e3, self._link_clock.get(link, 0.0) + 1e-6)
         self._link_clock[link] = at

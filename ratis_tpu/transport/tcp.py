@@ -112,7 +112,7 @@ class _Connection:
         if self._dead is not None:
             raise self._dead
         seq = next(self._seq)
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
         async with self._send_lock:
             self._writer.write(_encode_frame(seq, kind, body))
