@@ -383,6 +383,11 @@ class BulkHeartbeat:
 BULK_HB_OK = 0
 BULK_HB_NOT_LEADER = 1
 BULK_HB_UNKNOWN_GROUP = 2
+# Receiver skipped the item because the division's append lock was held by
+# an in-flight AppendEntries: that append itself resets the follower's
+# election deadline, and the leader simply retries next sweep — so the
+# sweep never waits on a contended division (no head-of-line blocking).
+BULK_HB_BUSY = 3
 
 
 @dataclasses.dataclass(frozen=True)

@@ -677,6 +677,12 @@ class Division:
             granted = True
         return reply(granted, state.current_term)
 
+    def append_lock_locked(self) -> bool:
+        """Whether an append/bulk-heartbeat is currently holding this
+        division's serialization lock (used by the server's bulk-heartbeat
+        receiver to defer contended items off its sequential sweep)."""
+        return self._append_lock.locked()
+
     async def handle_append_entries(self, req: AppendEntriesRequest
                                     ) -> AppendEntriesReply:
         with self.metrics.follower_append_timer.time():
@@ -759,7 +765,23 @@ class Division:
         carry commit_term; identical (term, index) implies an identical
         prefix, so committing up to it is exactly as safe as the prev-check
         path).  Anything this cannot verify is left to the full
-        AppendEntries probe the leader falls back to."""
+        AppendEntries probe the leader falls back to.
+
+        Runs under the same _append_lock that serializes
+        handle_append_entries: append_entries_follower awaits mid-scan
+        (truncate/flush), and a heartbeat from a new-term leader landing in
+        that window could change_to_follower and advance the commit index
+        over entries the resumed (now stale-leader) append then truncates —
+        destroying committed state.  The lock is uncontended on the idle
+        happy path this fast-path serves."""
+        async with self._append_lock:
+            return await self._on_bulk_heartbeat_locked(
+                leader_id, term, leader_commit, commit_term)
+
+    async def _on_bulk_heartbeat_locked(self, leader_id: RaftPeerId,
+                                        term: int, leader_commit: int,
+                                        commit_term: int
+                                        ) -> tuple[int, int, int, int, int]:
         from ratis_tpu.protocol.raftrpc import BULK_HB_NOT_LEADER, BULK_HB_OK
         state = self.state
         log = state.log

@@ -143,7 +143,8 @@ class LogAppender:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.buffer_byte_limit = buffer_byte_limit
         self.window_limit = max(1, window_limit)
-        self.sender = division.server.replication.sender_for(follower.peer_id)
+        self.sender = division.server.replication.acquire(
+            follower.peer_id, self)
         self._running = False
         self._epoch = 0        # bumped on window reset; stale replies ignored
         self._inflight = 0     # pipelined (non-heartbeat) requests outstanding
@@ -167,7 +168,14 @@ class LogAppender:
     async def stop(self) -> None:
         self._running = False
         self.sender.unmark(self)
-        tasks = list(self._pending_sends)
+        # stop() can be reached from INSIDE one of this appender's own
+        # pending tasks (e.g. _send_heartbeat's reply carries a higher term
+        # -> change_to_follower -> ctx.stop -> this): never cancel-and-await
+        # the task we are currently running in — the pending
+        # self-cancellation would detonate at the next await and abort the
+        # rest of the step-down cleanup.
+        cur = asyncio.current_task()
+        tasks = [t for t in self._pending_sends if t is not cur]
         self._pending_sends.clear()
         for t in tasks:
             t.cancel()
@@ -176,6 +184,10 @@ class LogAppender:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        # Retire the shared per-destination sender when this was its last
+        # appender (otherwise departed peers leak standing flush tasks).
+        await self.division.server.replication.release(
+            self.follower.peer_id, self)
 
     def notify(self) -> None:
         if self._running:
@@ -434,7 +446,10 @@ class LogAppender:
                 term, None, reason="higher term in bulk heartbeat reply")
             return
         if code != BULK_HB_OK:
-            return  # stale NOT_LEADER at <= our term: ignore
+            # stale NOT_LEADER at <= our term, or BUSY (the item was skipped
+            # because our own in-flight append holds the division's lock —
+            # that append doubles as the heartbeat): ignore, retry next sweep
+            return
         f = self.follower
         f.last_rpc_response_s = time.monotonic()
         if follower_commit > f.commit_index:

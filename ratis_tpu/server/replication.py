@@ -70,6 +70,7 @@ class PeerSender:
         self.metrics = metrics if metrics is not None else {
             "envelopes": 0, "items": 0}
         self._dirty: dict[object, None] = {}  # insertion-ordered appender set
+        self.refs: set = set()  # registered appenders (scheduler-managed)
         self._wake = asyncio.Event()
         self._slots = asyncio.Semaphore(max(1, inflight_cap))
         self._running = True
@@ -164,23 +165,28 @@ class PeerSender:
         server = self.server
         replies: list = []
         error: Optional[Exception] = None
+        remark = True
+        # One outer try/finally owns the latch + slot: ANY exit (including
+        # cancellation from a source other than close(), which used to skip
+        # the slot release and wedge the sender after inflight_cap events)
+        # releases the envelope slot and the appenders' busy latch.
         try:
-            if len(items) > 1:
-                env = AppendEnvelope(tuple(it.request for it in items))
-                reply = await server.send_server_rpc(self.to, env)
-                replies = list(reply.items)
-                if len(replies) != len(items):
-                    raise TimeoutIOException("envelope reply length mismatch")
-            else:
-                replies = [await server.send_server_rpc(
-                    self.to, items[0].request)]
-        except asyncio.CancelledError:
-            for it in items:
-                it.appender.envelope_done(remark=False)
-            raise
-        except Exception as e:
-            error = e
-        try:
+            try:
+                if len(items) > 1:
+                    env = AppendEnvelope(tuple(it.request for it in items))
+                    reply = await server.send_server_rpc(self.to, env)
+                    replies = list(reply.items)
+                    if len(replies) != len(items):
+                        raise TimeoutIOException(
+                            "envelope reply length mismatch")
+                else:
+                    replies = [await server.send_server_rpc(
+                        self.to, items[0].request)]
+            except asyncio.CancelledError:
+                remark = False
+                raise
+            except Exception as e:
+                error = e
             for i, it in enumerate(items):
                 rep = error if error is not None else replies[i]
                 try:
@@ -198,14 +204,20 @@ class PeerSender:
                                   server.peer_id, self.to)
         finally:
             for a in {it.appender for it in items}:
-                a.envelope_done()
+                a.envelope_done(remark=remark)
             self._slots.release()
             self._wake.set()
 
     async def close(self) -> None:
         self._running = False
         self._wake.set()
-        tasks = [self._task, *self._inflight_tasks]
+        # close() can be reached from INSIDE one of this sender's own
+        # inflight _send tasks (reply dispatch -> change_to_follower ->
+        # appender.stop -> scheduler.release): never cancel-and-await the
+        # task we are currently running in.
+        cur = asyncio.current_task()
+        tasks = [t for t in (self._task, *self._inflight_tasks)
+                 if t is not cur]
         self._inflight_tasks.clear()
         for t in tasks:
             t.cancel()
@@ -242,6 +254,24 @@ class ReplicationScheduler:
                            metrics=self.metrics)
             self._senders[to] = s
         return s
+
+    def acquire(self, to: RaftPeerId, appender) -> PeerSender:
+        """sender_for + register ``appender`` as a user; pair with
+        :meth:`release` so a sender (and its standing flush-loop task) is
+        retired when its last appender goes away under membership churn."""
+        s = self.sender_for(to)
+        s.refs.add(appender)
+        return s
+
+    async def release(self, to: RaftPeerId, appender) -> None:
+        s = self._senders.get(to)
+        if s is None:
+            return
+        s.refs.discard(appender)
+        s.unmark(appender)
+        if not s.refs:
+            self._senders.pop(to, None)
+            await s.close()
 
     async def close(self) -> None:
         self._closed = True

@@ -157,11 +157,17 @@ class BulkHeartbeatService:
         except asyncio.CancelledError:
             raise
         except Exception:
-            return  # next sweep retries; staleness covers persistent failure
+            # No send-clock rollback needed: the sweep period equals the
+            # heartbeat interval and the due check is 0.9x interval, so a
+            # failed item re-qualifies at the very next sweep anyway — the
+            # failure costs at most one sweep period, never a silent extra
+            # interval (unary mode routes the same failure through
+            # on_send_error for its backoff semantics).
+            return
         if len(reply.items) != len(items):
             LOG.warning("%s: bulk heartbeat reply misaligned from %s",
                         self.server.peer_id, to)
-            return
+            return  # items re-qualify next sweep (see send-failure note)
         for appender, item in zip(appenders, reply.items):
             try:
                 await appender.on_bulk_reply(*item)
@@ -474,26 +480,38 @@ class RaftServer:
     async def _handle_bulk_heartbeat(self, msg):
         """Follower side of the compact multi-group heartbeat: one small
         per-division happy-path step per item (leadership recognition +
-        deadline reset + log-matching-gated commit advance), sequential with
-        periodic yields.  Groups this server doesn't host reply
-        UNKNOWN_GROUP."""
+        deadline reset + log-matching-gated commit advance).  Items whose
+        division append lock is free run inline (the happy path never
+        suspends, so the sweep stays a tight loop); items contending with an
+        in-flight append are skipped with BULK_HB_BUSY so ONE division's
+        slow flush never head-of-line-blocks heartbeat delivery for later
+        divisions, nor the envelope's reply (and with it every co-hosted
+        group's ack freshness at the leader).  The skipped division's
+        election deadline is safe: the very append holding its lock resets
+        it on completion, and the leader retries next sweep.  Groups this
+        server doesn't host reply UNKNOWN_GROUP."""
         from ratis_tpu.protocol.ids import RaftGroupId
-        from ratis_tpu.protocol.raftrpc import (BULK_HB_UNKNOWN_GROUP,
+        from ratis_tpu.protocol.raftrpc import (BULK_HB_BUSY,
+                                                BULK_HB_UNKNOWN_GROUP,
                                                 BulkHeartbeatReply)
         src = msg.requestor_id
-        results = []
-        for n, (gid_bytes, term, commit, commit_term) in enumerate(msg.items):
+        items = msg.items
+        miss = (BULK_HB_UNKNOWN_GROUP, -1, -1, -1, -1)
+        busy = (BULK_HB_BUSY, -1, -1, -1, -1)
+        results: list = [miss] * len(items)
+        for n, (gid_bytes, term, commit, commit_term) in enumerate(items):
             div = self.divisions.get(RaftGroupId.value_of(gid_bytes))
             if div is None:
-                results.append((BULK_HB_UNKNOWN_GROUP, -1, -1, -1, -1))
+                pass  # results[n] stays UNKNOWN_GROUP
+            elif div.append_lock_locked():
+                results[n] = busy
             else:
                 try:
-                    results.append(await div.on_bulk_heartbeat(
-                        src, term, commit, commit_term))
+                    results[n] = await div.on_bulk_heartbeat(
+                        src, term, commit, commit_term)
                 except Exception:
                     LOG.exception("%s bulk heartbeat item failed",
                                   self.peer_id)
-                    results.append((BULK_HB_UNKNOWN_GROUP, -1, -1, -1, -1))
             if (n + 1) % 256 == 0:
                 await asyncio.sleep(0)
         return BulkHeartbeatReply(tuple(results))

@@ -26,10 +26,19 @@ def test_pause_detected_and_leader_steps_down():
         # Stall the entire event loop the way a synchronous compile or
         # GIL-holding native call would.
         time.sleep(1.2)
-        await asyncio.sleep(0.3)  # let the monitor run its check
+        # Let the monitor run its check: poll instead of a fixed sleep —
+        # under full-suite load the resumed loop can take a while to drain
+        # its ready-callback backlog before the monitor task runs.
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while asyncio.get_event_loop().time() < deadline:
+            if srv.pause_monitor.stepdown_count >= 1:
+                break
+            await asyncio.sleep(0.05)
         assert srv.pause_monitor.pause_count > 0
+        # stepdown_count >= 1 proves the abdication happened; the division
+        # may legitimately win re-election immediately afterwards, so do
+        # NOT assert on is_leader() here.
         assert srv.pause_monitor.stepdown_count >= 1
-        assert not leader.is_leader()
         # the cluster recovers: a (possibly new) leader serves writes
         await cluster.wait_for_leader()
         assert (await cluster.send_write()).success
