@@ -3,17 +3,22 @@
 Two measurements, reported as ONE JSON line:
 
 1. **End-to-end (primary)** — aggregate commits/sec + p50/p99 commit latency
-   across N RaftGroups hosted on an in-process 3-server trio with the
-   batched quorum engine engaged on every tick
-   (ratis_tpu.tools.bench_cluster; ladder rungs from BASELINE.json.configs:
-   1 group, 64 groups, 1024 groups).  ``vs_baseline`` compares the batched
-   engine against the same harness with the engine in per-group scalar mode
-   — the reference's cost shape (one Python pass per group per event, the
-   shape of LeaderStateImpl.updateCommit's per-division EventProcessor) —
-   at the headline group count.  The e2e rungs run on the CPU platform: the
-   consensus runtime is host-side asyncio and the only real TPU chip in the
-   harness is reached over a tunnel whose per-tick round-trip would measure
-   the tunnel, not the framework.
+   across N RaftGroups hosted on an in-process 3-server trio
+   (ratis_tpu.tools.bench_cluster).  The HEADLINE rung runs over REAL
+   localhost TCP sockets (the netty-analog transport): every RPC pays
+   framing + syscalls, so the reference's per-(group,follower) stream shape
+   costs what it actually costs — this is where the coalesced data path
+   (one AppendEnvelope per destination server) shows its structural
+   advantage.  ``vs_baseline`` compares the batched engine + coalescing
+   against the same harness in per-group scalar mode + per-group unary RPCs
+   (the reference's cost shape: thread-per-division commit math, one RPC
+   stream per group-follower) at the headline group count over the same
+   TCP transport.  A simulated-transport (direct function-call) ladder is
+   reported as secondary: it measures the framework's host-side runtime
+   with the socket costs removed.  The e2e rungs run on the CPU platform:
+   the consensus runtime is host-side asyncio and the only real TPU chip in
+   the harness is reached over a tunnel whose per-tick round-trip would
+   measure the tunnel, not the framework.
 2. **Kernel (secondary)** — fused engine_step dispatch rate over a
    [10k groups x 8 peers] batch on the default (real TPU when present)
    platform vs the pure-Python scalar loop: the batching-effect measure
@@ -59,7 +64,9 @@ def child_e2e(spec: str) -> None:
     async def main():
         out = await run_bench(cfg["groups"], cfg["writes"],
                               batched=cfg["batched"],
-                              concurrency=cfg.get("concurrency", 128))
+                              concurrency=cfg.get("concurrency", 128),
+                              warmup_writes=cfg.get("warmup", 1),
+                              transport=cfg.get("transport", "sim"))
         print("RESULT " + json.dumps(out))
 
     asyncio.run(main())
@@ -148,24 +155,38 @@ def _spread(xs: list[float]) -> float:
     return round((max(xs) - min(xs)) / m, 3) if m else 0.0
 
 
-def _run_trials(spec: str, n: int) -> list[dict]:
-    return [_run_child(["--e2e-child", spec]) for _ in range(n)]
+def _run_trials(spec: str, n: int,
+                timeout_s: float = 900.0) -> list[dict]:
+    return [_run_child(["--e2e-child", spec], timeout_s=timeout_s)
+            for _ in range(n)]
 
 
 def main() -> None:
+    # Simulated-transport ladder (secondary): host-runtime scaling shape.
+    # Writes are scaled so every rung measures a comparable steady-state
+    # window (~8k commits) instead of a burst.
     ladder: dict[int, list[dict]] = {}
-    for groups, writes, conc in ((1, 256, 32), (64, WRITES_PER_GROUP, 128),
-                                 (HEADLINE_GROUPS, WRITES_PER_GROUP, 128)):
+    for groups, writes, conc in ((1, 256, 32), (64, 128, 128),
+                                 (1024, 8, 128), (10_240, 2, 128)):
         if groups in ladder:
             continue
         spec = json.dumps({"groups": groups, "writes": writes,
-                           "batched": True, "concurrency": conc})
-        ladder[groups] = _run_trials(spec, TRIALS)
+                           "batched": True, "concurrency": conc,
+                           "transport": "sim",
+                           # leader hints come from bring-up; a warmup pass
+                           # at 10k groups doubles the rung's wall-clock
+                           "warmup": 0 if groups > 4096 else 1})
+        trials = TRIALS if groups <= HEADLINE_GROUPS else 1
+        ladder[groups] = _run_trials(spec, trials, timeout_s=1800.0)
 
-    headline = ladder[HEADLINE_GROUPS]
+    # HEADLINE: real localhost TCP sockets, batched vs scalar.
+    tcp_spec = json.dumps({"groups": HEADLINE_GROUPS,
+                           "writes": WRITES_PER_GROUP, "batched": True,
+                           "concurrency": 128, "transport": "tcp"})
+    headline = _run_trials(tcp_spec, TRIALS)
     scalar_spec = json.dumps({"groups": HEADLINE_GROUPS,
-                              "writes": WRITES_PER_GROUP,
-                              "batched": False, "concurrency": 128})
+                              "writes": WRITES_PER_GROUP, "batched": False,
+                              "concurrency": 128, "transport": "tcp"})
     scalar = _run_trials(scalar_spec, TRIALS)
     kernel = _run_child(["--kernel-child"])
 
@@ -180,24 +201,37 @@ def main() -> None:
         "unit": "commits/s",
         "vs_baseline": round(_median(headline_cps) / _median(scalar_cps), 2),
         "vs_baseline_definition": (
-            "median over %d trials: batched engine + coalesced data path vs "
-            "scalar per-group engine mode + per-group unary RPCs (the "
-            "reference's cost shape: thread-per-division commit math, one "
-            "RPC stream per group-follower), same harness and group count "
+            "median over %d trials at %d groups over REAL localhost TCP "
+            "sockets: batched engine + coalesced data/heartbeat path (one "
+            "AppendEnvelope / BulkHeartbeat per destination server) vs "
+            "scalar per-group engine mode + per-(group,follower) unary "
+            "RPCs (the reference's cost shape: thread-per-division commit "
+            "math, one RPC stream per group-follower pair, "
+            "GrpcLogAppender.java:343-381), same harness, same transport "
             "(Apache Ratis publishes no numbers to compare against - "
-            "BASELINE.md); kernel_vs_scalar_loop is the kernel batching "
-            "effect in isolation" % TRIALS),
+            "BASELINE.md); the sim_ladder secondary is the same harness "
+            "over direct function-call transport (socket costs removed); "
+            "kernel_vs_scalar_loop is the kernel batching effect in "
+            "isolation" % (TRIALS, HEADLINE_GROUPS)),
         "secondary": {
             "groups": HEADLINE_GROUPS,
             "trials": TRIALS,
+            "transport": "tcp",
             "p50_ms": med(headline, "p50_ms"),
             "p99_ms": med(headline, "p99_ms"),
-            "election_convergence_s": med(headline, "election_convergence_s"),
+            "election_convergence_s": med(headline,
+                                          "election_convergence_s"),
             "spread_batched": _spread(headline_cps),
             "spread_scalar": _spread(scalar_cps),
             "scalar_mode_commits_per_sec": _median(scalar_cps),
-            "ladder": {str(g): _median([t["commits_per_sec"] for t in r])
-                       for g, r in sorted(ladder.items())},
+            "sim_ladder": {str(g): _median([t["commits_per_sec"] for t in r])
+                           for g, r in sorted(ladder.items())},
+            "sim_ladder_p99_ms": {
+                str(g): _median([t["p99_ms"] for t in r])
+                for g, r in sorted(ladder.items())},
+            "sim_ladder_convergence_s": {
+                str(g): _median([t["election_convergence_s"] for t in r])
+                for g, r in sorted(ladder.items())},
             "kernel_group_updates_per_sec": kernel["group_updates_per_sec"],
             "kernel_vs_scalar_loop": kernel["vs_scalar_loop"],
             "kernel_platform": kernel["platform"],

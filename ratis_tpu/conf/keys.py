@@ -440,9 +440,21 @@ class GrpcConfigKeys:
     # address. "" = share the main port.
     CLIENT_PORT_KEY = "raft.grpc.client.port"
 
+    # Dedicated ADMIN endpoint (the reference optionally runs THREE gRPC
+    # servers — server/client/admin — each with its own TLS,
+    # GrpcServicesImpl.java:56,197-224).  When set, admin request types are
+    # served on this port (and ONLY admin types; data-plane requests are
+    # rejected there).  "" = admin shares the client (or main) endpoint.
+    ADMIN_PORT_KEY = "raft.grpc.admin.port"
+
     @staticmethod
     def client_port(p: RaftProperties):
         v = p.get(GrpcConfigKeys.CLIENT_PORT_KEY)
+        return int(v) if v else None
+
+    @staticmethod
+    def admin_port(p: RaftProperties):
+        v = p.get(GrpcConfigKeys.ADMIN_PORT_KEY)
         return int(v) if v else None
 
     class Tls:
@@ -480,6 +492,81 @@ class GrpcConfigKeys:
         @staticmethod
         def name_override(p: RaftProperties):
             return p.get(GrpcConfigKeys.Tls.NAME_OVERRIDE_KEY)
+
+    class AdminTls:
+        """Admin-endpoint TLS override (the reference's admin server takes
+        its own GrpcTlsConfig, GrpcServicesImpl.java:56,219-224).  When not
+        enabled, the admin endpoint inherits the main Tls block."""
+
+        ENABLED_KEY = "raft.grpc.admin.tls.enabled"
+        ENABLED_DEFAULT = False
+        CERT_CHAIN_KEY = "raft.grpc.admin.tls.cert.chain.path"
+        PRIVATE_KEY_KEY = "raft.grpc.admin.tls.private.key.path"
+        TRUST_ROOT_KEY = "raft.grpc.admin.tls.trust.root.path"
+        MUTUAL_AUTH_KEY = "raft.grpc.admin.tls.mutual.auth.enabled"
+        MUTUAL_AUTH_DEFAULT = False
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(GrpcConfigKeys.AdminTls.ENABLED_KEY,
+                                 GrpcConfigKeys.AdminTls.ENABLED_DEFAULT)
+
+        @staticmethod
+        def cert_chain(p: RaftProperties):
+            return p.get(GrpcConfigKeys.AdminTls.CERT_CHAIN_KEY)
+
+        @staticmethod
+        def private_key(p: RaftProperties):
+            return p.get(GrpcConfigKeys.AdminTls.PRIVATE_KEY_KEY)
+
+        @staticmethod
+        def trust_root(p: RaftProperties):
+            return p.get(GrpcConfigKeys.AdminTls.TRUST_ROOT_KEY)
+
+        @staticmethod
+        def mutual_auth(p: RaftProperties) -> bool:
+            return p.get_boolean(GrpcConfigKeys.AdminTls.MUTUAL_AUTH_KEY,
+                                 GrpcConfigKeys.AdminTls.MUTUAL_AUTH_DEFAULT)
+
+
+class NettyConfigKeys:
+    """Raw-TCP (netty-analog) transport keys (reference NettyConfigKeys,
+    ratis-netty/.../NettyConfigKeys.java; the TLS block mirrors what the
+    reference's gRPC transport gets from GrpcTlsConfig — the netty analog
+    here supports TLS so no transport is plaintext-only)."""
+
+    PREFIX = "raft.netty"
+
+    class Tls:
+        ENABLED_KEY = "raft.netty.tls.enabled"
+        ENABLED_DEFAULT = False
+        CERT_CHAIN_KEY = "raft.netty.tls.cert.chain.path"
+        PRIVATE_KEY_KEY = "raft.netty.tls.private.key.path"
+        TRUST_ROOT_KEY = "raft.netty.tls.trust.root.path"
+        MUTUAL_AUTH_KEY = "raft.netty.tls.mutual.auth.enabled"
+        MUTUAL_AUTH_DEFAULT = False
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(NettyConfigKeys.Tls.ENABLED_KEY,
+                                 NettyConfigKeys.Tls.ENABLED_DEFAULT)
+
+        @staticmethod
+        def cert_chain(p: RaftProperties):
+            return p.get(NettyConfigKeys.Tls.CERT_CHAIN_KEY)
+
+        @staticmethod
+        def private_key(p: RaftProperties):
+            return p.get(NettyConfigKeys.Tls.PRIVATE_KEY_KEY)
+
+        @staticmethod
+        def trust_root(p: RaftProperties):
+            return p.get(NettyConfigKeys.Tls.TRUST_ROOT_KEY)
+
+        @staticmethod
+        def mutual_auth(p: RaftProperties) -> bool:
+            return p.get_boolean(NettyConfigKeys.Tls.MUTUAL_AUTH_KEY,
+                                 NettyConfigKeys.Tls.MUTUAL_AUTH_DEFAULT)
 
 
 class RaftClientConfigKeys:

@@ -43,6 +43,15 @@ class GroupBatchState:
         self.first_leader_index = np.zeros(g, np.int32)
         self.last_ack_ms = np.zeros((g, p), np.int32)
         self.election_deadline_ms = np.full(g, NO_DEADLINE, np.int32)
+        # Candidate vote-round state (batched elections, SURVEY §3.3 HOT
+        # LOOP #2): grant/reject masks + round deadline; NO_DEADLINE means
+        # no round in flight for the slot.  Tallied for every candidate in
+        # one ops.quorum.tally_votes dispatch per engine tick, replacing
+        # the reference's per-division waitForResults loop
+        # (LeaderElection.java:498-592).
+        self.vote_grants = np.zeros((g, p), bool)
+        self.vote_rejects = np.zeros((g, p), bool)
+        self.vote_deadline_ms = np.full(g, NO_DEADLINE, np.int32)
         self._free: list[int] = list(range(g - 1, -1, -1))
         self.active: set[int] = set()
         # Slots whose host-side state changed since the last engine tick.
@@ -72,6 +81,9 @@ class GroupBatchState:
         self.flush_index[slot] = -1
         self.commit_index[slot] = -1
         self.election_deadline_ms[slot] = NO_DEADLINE
+        self.vote_grants[slot] = False
+        self.vote_rejects[slot] = False
+        self.vote_deadline_ms[slot] = NO_DEADLINE
         self._free.append(slot)
         self.mark_dirty(slot)
 
@@ -82,17 +94,18 @@ class GroupBatchState:
         new = old * 2
         for name in ("role", "self_slot", "flush_index", "commit_index",
                      "first_leader_index", "election_deadline_ms",
-                     "self_priority"):
+                     "self_priority", "vote_deadline_ms"):
             a = getattr(self, name)
             b = np.zeros(new, a.dtype)
             b[:old] = a
             if name == "flush_index" or name == "commit_index":
                 b[old:] = -1
-            if name == "election_deadline_ms":
+            if name in ("election_deadline_ms", "vote_deadline_ms"):
                 b[old:] = NO_DEADLINE
             setattr(self, name, b)
         for name in ("self_mask", "conf_cur", "conf_old", "priority",
-                     "match_index", "next_index", "last_ack_ms"):
+                     "match_index", "next_index", "last_ack_ms",
+                     "vote_grants", "vote_rejects"):
             a = getattr(self, name)
             b = np.zeros((new, self.max_peers), a.dtype)
             b[:old] = a

@@ -505,14 +505,20 @@ class Division:
         self._spawn_bg(self.state_machine.notify_extended_no_leader(
             self.role_info()))
 
-    async def on_commit_advance(self, new_commit: int) -> None:
-        """Engine advanced this group's commit (leader only)."""
+    def on_commit_advance_now(self, new_commit: int) -> None:
+        """Engine advanced this group's commit (leader only).  Synchronous
+        on purpose: the engine calls this INLINE from the ack intake path
+        (QuorumEngine.on_ack) so a commit never waits for the tick task to
+        win a turn on a loaded event loop; the body must stay await-free."""
         if not self.is_leader():
             return
         self.state.log.update_commit_index(new_commit,
                                            self.state.current_term, True)
         self._apply_wake.set()
         self._update_watch_frontiers()
+
+    async def on_commit_advance(self, new_commit: int) -> None:
+        self.on_commit_advance_now(new_commit)
 
     async def on_leadership_stale(self) -> None:
         if self.is_leader():
@@ -1815,6 +1821,12 @@ class Division:
                     except Exception:
                         LOG.exception("%s data_link failed", self.member_id)
             try:
+                # applyTransactionSerial runs strictly in log order ahead of
+                # applyTransaction (StateMachine.java:565: the serial hook
+                # for state machines that parallelize the main apply); the
+                # updater daemon here is itself serial, so the pair runs
+                # back-to-back per entry in index order.
+                trx = await sm.apply_transaction_serial(trx)
                 reply_message = await sm.apply_transaction(trx)
                 self.sm_metrics.applied_count.inc()
             except Exception as e:

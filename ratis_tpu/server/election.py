@@ -55,6 +55,11 @@ class LeaderElection:
 
     def stop(self) -> None:
         self._stopped = True
+        div = self.division
+        if div.engine_slot >= 0:
+            # abandon any engine-tallied round immediately (otherwise the
+            # awaiting candidate task lingers until the round deadline)
+            div.server.engine.end_vote_round(div.engine_slot)
 
     async def run(self) -> None:
         """One full attempt: optional PRE_VOTE, then ELECTION; on success the
@@ -112,6 +117,11 @@ class LeaderElection:
 
         if conf.is_single_mode(div.member_id.peer_id) or not others:
             return Result.PASSED, term
+
+        engine = div.server.engine
+        if engine.tally_batched and div.engine_slot >= 0:
+            return await self._ask_for_votes_batched(phase, term, last,
+                                                     others)
 
         # slot-indexed tallies for ops.reference.tally_votes
         slots = div.peer_slots
@@ -204,3 +214,86 @@ class LeaderElection:
         if conf.is_single_mode(div.member_id.peer_id):
             return Result.SINGLE_MODE_PASSED, term
         return (Result.REJECTED if rejected else Result.TIMEOUT), term
+
+    async def _ask_for_votes_batched(self, phase: Phase, term: int, last,
+                                     others) -> tuple[Result, int]:
+        """Engine-tallied round (SURVEY §3.3 HOT LOOP #2): vote replies
+        stream into the engine as packed events, and ONE jitted
+        ops.quorum.tally_votes dispatch per tick decides every concurrent
+        round on this server — the scalar per-reply loop above remains the
+        differential oracle and the below-threshold path.
+
+        Special replies the tally kernel cannot express (shutdown, a
+        higher discovered term) are handled inline by the reply tasks:
+        they abandon the engine round and the result is returned directly.
+        """
+        div = self.division
+        engine = div.server.engine
+        slot = div.engine_slot
+        slots = div.peer_slots
+        deadline_ms = (engine.clock.now_ms()
+                       + int(div.random_election_timeout_s() * 1000))
+        fut = engine.begin_vote_round(slot, deadline_ms)
+        special: dict = {}
+
+        header = lambda to: RaftRpcHeader(div.member_id.peer_id, to.id,
+                                          div.group_id)
+        request = lambda to: RequestVoteRequest(
+            header(to), term, last, pre_vote=(phase == Phase.PRE_VOTE),
+            force=self.force)
+
+        async def _one(peer):
+            try:
+                reply = await div.server.send_server_rpc(peer.id,
+                                                         request(peer))
+            except Exception:
+                return
+            if fut.done():
+                return
+            if reply.should_shutdown:
+                special["result"] = (Result.SHUTDOWN, term)
+                engine.end_vote_round(slot)
+                return
+            if reply.term > term:
+                # record only; the step-down itself runs in the MAIN
+                # election coroutine below — doing it here would let the
+                # main coroutine's task cleanup cancel change_to_follower
+                # mid-transition (role flipped, term never persisted)
+                special["result"] = (Result.DISCOVERED_A_NEW_TERM,
+                                     reply.term)
+                engine.end_vote_round(slot)
+                return
+            s = slots.get(reply.header.requestor_id)
+            if s is not None:
+                engine.on_vote_reply(slot, s, reply.granted)
+
+        tasks = [asyncio.create_task(_one(p)) for p in others]
+        try:
+            result_str = await fut
+        except asyncio.CancelledError:
+            if not fut.cancelled():
+                raise  # the election task itself was cancelled
+            # round abandoned (special reply / stop / step-down)
+            result, new_term = special.get("result",
+                                           (Result.SHUTDOWN, term))
+            if result == Result.DISCOVERED_A_NEW_TERM:
+                await div.change_to_follower(
+                    new_term, None, reason="higher term in vote reply")
+            return result, new_term
+        finally:
+            for t in tasks:
+                t.cancel()
+        if self._stopped:
+            return Result.SHUTDOWN, term
+        result = {
+            "PASSED": Result.PASSED,
+            "REJECTED": Result.REJECTED,
+            "TIMEOUT": Result.TIMEOUT,
+        }[result_str]
+        if result in (Result.REJECTED, Result.TIMEOUT) \
+                and div.state.configuration.is_single_mode(
+                    div.member_id.peer_id):
+            # conf shrank to single mode mid-round: the scalar oracle's
+            # deadline tally passes here (election.py timeout path)
+            return Result.SINGLE_MODE_PASSED, term
+        return result, term

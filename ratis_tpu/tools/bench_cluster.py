@@ -38,12 +38,32 @@ from ratis_tpu.transport.simulated import (SimulatedNetwork,
 def bench_properties(batched: bool, num_groups: int = 1) -> RaftProperties:
     from ratis_tpu.engine.engine import QuorumEngine
     p = RaftProperties()
-    # Long timeouts: at 1k+ groups the background heartbeat volume scales
-    # with group count (one appender per follower per group, like the
-    # reference); 1s/2s keeps idle traffic at ~4k RPC/s for 1024 groups and
-    # widens the leadership-staleness window past event-loop queueing noise.
-    RaftServerConfigKeys.Rpc.set_timeout(p, "1s", "2s")
-    p.set("raft.tpu.engine.tick-interval", "2ms")
+    # Timeouts scale with group density: background heartbeat volume is
+    # O(groups x followers / interval) (one appender per follower per
+    # group, like the reference), so a fixed 1s/2s that is fine at 64
+    # groups makes 1024+ co-hosted groups spend a third of the host on
+    # idle-channel upkeep.  Multi-raft deployments tune exactly this knob
+    # (election timeout up, heartbeat interval with it) as groups/host
+    # grows; both engine modes get the same setting, so the batched/scalar
+    # comparison is unaffected.
+    if num_groups >= 8192:
+        RaftServerConfigKeys.Rpc.set_timeout(p, "8s", "16s")
+    elif num_groups >= 4096:
+        RaftServerConfigKeys.Rpc.set_timeout(p, "4s", "8s")
+    elif num_groups >= 512:
+        RaftServerConfigKeys.Rpc.set_timeout(p, "2s", "4s")
+    else:
+        RaftServerConfigKeys.Rpc.set_timeout(p, "1s", "2s")
+    if batched:
+        # Commits advance inline at ack intake (QuorumEngine.on_ack), so
+        # the device tick only drives election timeouts (1-2s here) and
+        # staleness sweeps: a 20ms cadence loses nothing while cutting the
+        # per-dispatch overhead 10x — and each dispatch carries a 10x
+        # larger packed event batch, which is exactly the shape the TPU
+        # kernel wants.
+        p.set("raft.tpu.engine.tick-interval", "20ms")
+    else:
+        p.set("raft.tpu.engine.tick-interval", "2ms")
     # Pre-size the engine so adding N groups never regrows the batch arrays
     # (each regrow is a new kernel shape -> a compile stall mid-run).
     p.set(RaftServerConfigKeys.Engine.MAX_GROUPS_KEY,
@@ -71,14 +91,36 @@ class BenchCluster:
     """A 3-server in-process trio hosting ``num_groups`` sibling groups."""
 
     def __init__(self, num_groups: int, num_servers: int = 3,
-                 batched: bool = True):
+                 batched: bool = True, transport: str = "sim"):
         self.num_groups = num_groups
         self.batched = batched
-        self.network = SimulatedNetwork()
-        self.factory = SimulatedTransportFactory(self.network)
+        self.transport = transport
+        if transport == "tcp":
+            # Real localhost sockets (the netty-analog transport): every
+            # RPC pays framing + syscalls, so the per-(group,follower)
+            # stream shape costs what it costs the reference — the rung
+            # that proves the coalesced paths survive a real transport.
+            import socket
+
+            from ratis_tpu.transport.tcp import TcpTransportFactory
+            self.network = None
+            self.factory = TcpTransportFactory()
+
+            def _port() -> int:
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    return s.getsockname()[1]
+
+            peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
+                              address=f"127.0.0.1:{_port()}")
+                     for i in range(num_servers)]
+        else:
+            self.network = SimulatedNetwork()
+            self.factory = SimulatedTransportFactory(self.network)
+            peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
+                              address=f"sim:s{i}")
+                     for i in range(num_servers)]
         self.properties = bench_properties(batched, num_groups)
-        peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"), address=f"sim:s{i}")
-                 for i in range(num_servers)]
         self.groups = [RaftGroup.value_of(RaftGroupId.random_id(), peers)
                        for _ in range(num_groups)]
         self.servers: list[RaftServer] = [
@@ -90,14 +132,17 @@ class BenchCluster:
             for p in peers]
         self._call_ids = itertools.count(1)
         self.election_convergence_s: float = 0.0
+        self.prewarm_s: float = 0.0
         self._leader_hint: dict[RaftGroupId, RaftServer] = {}
 
     async def start(self) -> None:
-        t0 = time.monotonic()
         if self.batched:
             # Compile every pad bucket before elections begin: a mid-run
             # compile stall is long enough to fire election timeouts.  The
             # jitted step is process-shared, so one engine warms all three.
+            # Compilation is NOT part of election convergence (it is paid
+            # once per process, not once per bring-up) — timed separately.
+            tw = time.monotonic()
             buckets, b = [], 64
             from ratis_tpu.engine.engine import QuorumEngine
             top = max(QuorumEngine._bucket(self.num_groups), 64)
@@ -107,19 +152,44 @@ class BenchCluster:
             self.servers[0].engine.prewarm(
                 group_counts=[x for x in buckets if x <= top],
                 event_counts=buckets)
+            self.prewarm_s = time.monotonic() - tw
+        t0 = time.monotonic()
         await asyncio.gather(*(s.start() for s in self.servers))
-        # Wave-wise group bring-up: 1024 simultaneous election storms have a
-        # long vote-split tail under a saturated event loop; bounded waves
-        # converge in near-linear time (and mirror incremental group-add in
-        # a real deployment).
+        # Wave-wise group bring-up with OPERATOR-TRIGGERED elections: after
+        # each wave's group-add, server 0's divisions force an immediate
+        # election (the reference's startLeaderElection admin path,
+        # RaftServerImpl.java:1735) instead of every group waiting out a
+        # randomized 1-2s timeout — 1024 deliberate timeout storms through
+        # one event loop was the old 30s bring-up.  The timeout path stays
+        # as the fallback for any group whose forced election loses a race.
+        import os
+        import sys
+        trace = os.environ.get("RATIS_BENCH_TRACE")
         wave = 128
+        await self._force_elections([self.groups[0]])
         await self._wait_all_leaders([self.groups[0]])
         for i in range(1, len(self.groups), wave):
             batch = self.groups[i:i + wave]
-            for g in batch:
-                await asyncio.gather(*(s.group_add(g) for s in self.servers))
+            tw = time.monotonic()
+            await asyncio.gather(*(s.group_add(g) for g in batch
+                                   for s in self.servers))
+            t_add = time.monotonic() - tw
+            await self._force_elections(batch)
             await self._wait_all_leaders(batch)
+            if trace:
+                print(f"bench: wave@{i} add={t_add:.2f}s "
+                      f"elect={time.monotonic() - tw - t_add:.2f}s",
+                      file=sys.stderr, flush=True)
         self.election_convergence_s = time.monotonic() - t0
+
+    async def _force_elections(self, groups: list[RaftGroup]) -> None:
+        starts = []
+        for g in groups:
+            d = self.servers[0].divisions.get(g.group_id)
+            if d is not None and d.is_follower():
+                starts.append(d.change_to_candidate(force=True))
+        if starts:
+            await asyncio.gather(*starts, return_exceptions=True)
 
     async def _wait_all_leaders(self, groups: list[RaftGroup],
                                 timeout: float = 120.0) -> None:
@@ -212,16 +282,33 @@ class BenchCluster:
             "p50_ms": round(latencies[n // 2] * 1e3, 2),
             "p99_ms": round(latencies[min(n - 1, (n * 99) // 100)] * 1e3, 2),
             "election_convergence_s": round(self.election_convergence_s, 2),
+            "prewarm_s": round(self.prewarm_s, 2),
         }
 
 
 async def run_bench(num_groups: int, writes_per_group: int,
                     batched: bool = True, concurrency: int = 256,
-                    warmup_writes: int = 1) -> dict:
+                    warmup_writes: int = 1, transport: str = "sim") -> dict:
     """One ladder rung: build the trio, elect, warm up, measure, tear down."""
-    cluster = BenchCluster(num_groups, batched=batched)
+    import gc
+    # Defer gen-2 cascades during bring-up (30k divisions allocated while
+    # transient asyncio objects churn gen-0); gen-0 stays at its default so
+    # short-lived cycles are still reclaimed promptly.
+    gc.set_threshold(700, 1000, 1000)
+    cluster = BenchCluster(num_groups, batched=batched, transport=transport)
     try:
         await cluster.start()
+        # GC hygiene for a multi-GB live heap: at 10k groups the cluster
+        # holds ~30k divisions of long-lived objects; CPython's gen-2
+        # collections rescan all of it on a cadence driven by transient
+        # allocation (a single pass measured 52s at 10240 groups — the
+        # event loop pause monitor caught it).  Freeze the post-bring-up
+        # heap out of the collector and keep gen-0/1 small-object cycling
+        # cheap.  (The JVM reference needs the analogous tuning; its
+        # JvmPauseMonitor exists precisely because GC stalls look like
+        # dead peers.)
+        gc.collect()
+        gc.freeze()
         if warmup_writes:
             await cluster.run_load(warmup_writes, concurrency)
         result = await cluster.run_load(writes_per_group, concurrency)
@@ -231,6 +318,7 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["engine_ticks"] = sum(e.metrics["ticks"] for e in engines)
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"
+        result["transport"] = transport
         return result
     finally:
         await cluster.close()

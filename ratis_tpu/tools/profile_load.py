@@ -21,6 +21,7 @@ def _force_cpu_platform():
 def main():
     _force_cpu_platform()
     groups = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    writes = int(sys.argv[3]) if len(sys.argv) > 3 else 8
     batched = (sys.argv[2] != "scalar") if len(sys.argv) > 2 else True
     from ratis_tpu.tools.bench_cluster import BenchCluster
 
@@ -31,7 +32,7 @@ def main():
             await cluster.run_load(1, 128)  # warmup
             prof = cProfile.Profile()
             prof.enable()
-            result = await cluster.run_load(8, 128)
+            result = await cluster.run_load(writes, 128)
             prof.disable()
             print("RESULT " + json.dumps(result))
             s = io.StringIO()

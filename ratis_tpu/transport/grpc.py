@@ -81,6 +81,21 @@ class GrpcTlsConfig:
             mutual_auth=GrpcConfigKeys.Tls.mutual_auth(p),
             target_name_override=GrpcConfigKeys.Tls.name_override(p))
 
+    @staticmethod
+    def admin_from_properties(p) -> Optional["GrpcTlsConfig"]:
+        """The admin endpoint's own TLS block (reference admin
+        GrpcTlsConfig, GrpcServicesImpl.java:56,219-224); falls back to the
+        main Tls block when not separately enabled."""
+        from ratis_tpu.conf.keys import GrpcConfigKeys
+        if p is None or not GrpcConfigKeys.AdminTls.enabled(p):
+            return GrpcTlsConfig.from_properties(p)
+        return GrpcTlsConfig(
+            cert_chain_path=GrpcConfigKeys.AdminTls.cert_chain(p),
+            private_key_path=GrpcConfigKeys.AdminTls.private_key(p),
+            trust_root_path=GrpcConfigKeys.AdminTls.trust_root(p),
+            mutual_auth=GrpcConfigKeys.AdminTls.mutual_auth(p),
+            target_name_override=GrpcConfigKeys.Tls.name_override(p))
+
     def _read(self, path: Optional[str]) -> Optional[bytes]:
         return pathlib.Path(path).read_bytes() if path else None
 
@@ -261,7 +276,9 @@ class GrpcServerTransport(ServerTransport):
                  = None,
                  request_timeout_s: float = 3.0,
                  tls: Optional[GrpcTlsConfig] = None,
-                 client_port: Optional[int] = None):
+                 client_port: Optional[int] = None,
+                 admin_port: Optional[int] = None,
+                 admin_tls: Optional[GrpcTlsConfig] = None):
         self.peer_id = peer_id
         self._address = address
         self._bound_port: Optional[int] = None
@@ -271,6 +288,12 @@ class GrpcServerTransport(ServerTransport):
         self.client_port = client_port
         self._client_server: Optional[grpc.aio.Server] = None
         self.bound_client_port: Optional[int] = None
+        # optional THIRD endpoint serving ONLY admin request types, with its
+        # own TLS config (GrpcServicesImpl.java:56,197-224)
+        self.admin_port = admin_port
+        self.admin_tls = admin_tls
+        self._admin_server: Optional[grpc.aio.Server] = None
+        self.bound_admin_port: Optional[int] = None
         self.server_handler = server_handler
         self.client_handler = client_handler
         self.peer_resolver = peer_resolver
@@ -336,6 +359,32 @@ class GrpcServerTransport(ServerTransport):
                 self._handle_client, request_deserializer=_identity,
                 response_serializer=_identity)})
 
+    async def _handle_admin(self, request_bytes: bytes, context) -> bytes:
+        """Admin endpoint: serves ONLY the admin request types; data-plane
+        requests are rejected so the dedicated port is genuinely an admin
+        plane (firewallable separately, like the reference's admin
+        server)."""
+        from ratis_tpu.protocol.requests import RequestType
+        try:
+            request = RaftClientRequest.from_bytes(request_bytes)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                f"undecodable admin request: {e}")
+        if request.type.type < RequestType.SET_CONFIGURATION:
+            # admin types are the 8..14 block (SET_CONFIGURATION and up)
+            await context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{request.type.type.name} is not an admin operation")
+        reply = await self.client_handler(request)
+        return reply.to_bytes()
+
+    def _admin_handlers(self):
+        return grpc.method_handlers_generic_handler(
+            CLIENT_SERVICE,
+            {"request": grpc.unary_unary_rpc_method_handler(
+                self._handle_admin, request_deserializer=_identity,
+                response_serializer=_identity)})
+
     def _generic_handlers(self):
         server_handlers = grpc.method_handlers_generic_handler(
             SERVER_SERVICE,
@@ -352,10 +401,12 @@ class GrpcServerTransport(ServerTransport):
             return [server_handlers]
         return [server_handlers, self._client_handlers()]
 
-    def _bind(self, server: grpc.aio.Server, address: str) -> int:
-        if self.tls is not None:
+    def _bind(self, server: grpc.aio.Server, address: str,
+              tls: Optional[GrpcTlsConfig] = None) -> int:
+        tls = tls if tls is not None else self.tls
+        if tls is not None:
             return server.add_secure_port(address,
-                                          self.tls.server_credentials())
+                                          tls.server_credentials())
         return server.add_insecure_port(address)
 
     async def start(self) -> None:
@@ -393,15 +444,48 @@ class GrpcServerTransport(ServerTransport):
                 await self._server.stop(grace=0)
                 self._server = None
                 raise
-        LOG.info("%s: grpc bound %s%s%s", self.peer_id, self.address,
+        if self.admin_port is not None:
+            # third endpoint: admin plane with its own TLS config
+            try:
+                host = self._address.rsplit(":", 1)[0]
+                admin_server = grpc.aio.server(options=_CHANNEL_OPTIONS)
+                admin_server.add_generic_rpc_handlers(
+                    [self._admin_handlers()])
+                self.bound_admin_port = self._bind(
+                    admin_server, f"{host}:{self.admin_port}",
+                    tls=self.admin_tls)
+                if self.bound_admin_port == 0:
+                    raise RaftException(
+                        f"{self.peer_id}: cannot bind admin port "
+                        f"{self.admin_port}")
+                await admin_server.start()
+                self._admin_server = admin_server
+            except BaseException:
+                try:
+                    await admin_server.stop(grace=0)
+                except Exception:
+                    pass
+                self.bound_admin_port = None
+                if self._client_server is not None:
+                    await self._client_server.stop(grace=0)
+                    self._client_server = None
+                await self._server.stop(grace=0)
+                self._server = None
+                raise
+        LOG.info("%s: grpc bound %s%s%s%s", self.peer_id, self.address,
                  " (tls)" if self.tls is not None else "",
                  f" client-port {self.bound_client_port}"
-                 if self._client_server is not None else "")
+                 if self._client_server is not None else "",
+                 f" admin-port {self.bound_admin_port}"
+                 if self._admin_server is not None else "")
 
     async def close(self) -> None:
         for stream in list(self._append_streams.values()):
             await stream.close()
         self._append_streams.clear()
+        if self._admin_server is not None:
+            await self._admin_server.stop(grace=0.2)
+            self._admin_server = None
         if self._client_server is not None:
             await self._client_server.stop(grace=0.2)
             self._client_server = None
@@ -512,10 +596,15 @@ class GrpcTransportFactory(TransportFactory):
                 RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_KEY,
                 RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_DEFAULT).seconds
             client_port = GrpcConfigKeys.client_port(properties)
+        admin_port = (GrpcConfigKeys.admin_port(properties)
+                      if properties is not None else None)
         return GrpcServerTransport(peer_id, address, server_handler,
                                    client_handler, peer_resolver, timeout_s,
                                    tls=GrpcTlsConfig.from_properties(properties),
-                                   client_port=client_port)
+                                   client_port=client_port,
+                                   admin_port=admin_port,
+                                   admin_tls=GrpcTlsConfig.admin_from_properties(
+                                       properties))
 
     def new_client_transport(self, properties=None) -> ClientTransport:
         return GrpcClientTransport(

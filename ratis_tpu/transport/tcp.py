@@ -62,12 +62,63 @@ async def _read_frame(reader: asyncio.StreamReader):
     return call_seq, kind, body[9:]
 
 
+class TcpTlsConfig:
+    """TLS for the raw-TCP transport (NettyConfigKeys.Tls): same parameter
+    surface as the gRPC GrpcTlsConfig — cert chain + key server-side,
+    optional trust root, optional mutual auth — applied as ssl contexts on
+    asyncio start_server / open_connection."""
+
+    def __init__(self, cert_chain_path=None, private_key_path=None,
+                 trust_root_path=None, mutual_auth=False):
+        self.cert_chain_path = cert_chain_path
+        self.private_key_path = private_key_path
+        self.trust_root_path = trust_root_path
+        self.mutual_auth = mutual_auth
+
+    @staticmethod
+    def from_properties(p) -> "TcpTlsConfig | None":
+        from ratis_tpu.conf.keys import NettyConfigKeys
+        if p is None or not NettyConfigKeys.Tls.enabled(p):
+            return None
+        return TcpTlsConfig(
+            cert_chain_path=NettyConfigKeys.Tls.cert_chain(p),
+            private_key_path=NettyConfigKeys.Tls.private_key(p),
+            trust_root_path=NettyConfigKeys.Tls.trust_root(p),
+            mutual_auth=NettyConfigKeys.Tls.mutual_auth(p))
+
+    def server_context(self):
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_chain_path, self.private_key_path)
+        if self.trust_root_path:
+            ctx.load_verify_locations(self.trust_root_path)
+        if self.mutual_auth:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def client_context(self):
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        # cluster-internal trust root, not the system store; hostname
+        # checks are disabled because peers dial each other by raw IP
+        ctx.check_hostname = False
+        if self.trust_root_path:
+            ctx.load_verify_locations(self.trust_root_path)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        else:
+            ctx.verify_mode = ssl.CERT_NONE
+        if self.mutual_auth and self.cert_chain_path:
+            ctx.load_cert_chain(self.cert_chain_path, self.private_key_path)
+        return ctx
+
+
 class _Connection:
     """One outbound connection multiplexing calls by sequence number
     (reference NettyRpcProxy channel)."""
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str, tls=None) -> None:
         self.address = address
+        self._tls = tls
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._writer: Optional[asyncio.StreamWriter] = None
@@ -78,8 +129,9 @@ class _Connection:
 
     async def connect(self) -> None:
         host, port = self.address.rsplit(":", 1)
+        ssl_ctx = self._tls.client_context() if self._tls is not None else None
         self._reader, self._writer = await asyncio.open_connection(
-            host, int(port))
+            host, int(port), ssl=ssl_ctx)
         self._recv_task = asyncio.create_task(
             self._recv_loop(), name=f"tcp-rpc-recv-{self.address}")
 
@@ -143,9 +195,10 @@ class _Connection:
 class _ConnectionPool:
     """address -> cached connection; reconnects dead ones on demand."""
 
-    def __init__(self) -> None:
+    def __init__(self, tls=None) -> None:
         self._conns: Dict[str, _Connection] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
+        self._tls = tls
 
     async def get(self, address: str) -> _Connection:
         lock = self._locks.setdefault(address, asyncio.Lock())
@@ -155,7 +208,7 @@ class _ConnectionPool:
                 return conn
             if conn is not None:
                 await conn.close()
-            conn = _Connection(address)
+            conn = _Connection(address, tls=self._tls)
             await conn.connect()
             self._conns[address] = conn
             return conn
@@ -175,7 +228,8 @@ class TcpServerTransport(ServerTransport):
                  client_handler: ClientRequestHandler,
                  peer_resolver: Optional[Callable[[RaftPeerId],
                                                   Optional[str]]] = None,
-                 request_timeout_s: float = 3.0):
+                 request_timeout_s: float = 3.0,
+                 tls: "TcpTlsConfig | None" = None):
         self.peer_id = peer_id
         self._address = address
         self._bound_port: Optional[int] = None
@@ -183,14 +237,16 @@ class TcpServerTransport(ServerTransport):
         self.client_handler = client_handler
         self.peer_resolver = peer_resolver
         self.request_timeout_s = request_timeout_s
+        self.tls = tls
         self._server: Optional[asyncio.AbstractServer] = None
-        self._pool = _ConnectionPool()
+        self._pool = _ConnectionPool(tls=tls)
         self._accepted: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> None:
         host, port = self._address.rsplit(":", 1)
+        ssl_ctx = self.tls.server_context() if self.tls is not None else None
         self._server = await asyncio.start_server(self._on_connect, host,
-                                                  int(port))
+                                                  int(port), ssl=ssl_ctx)
         self._bound_port = self._server.sockets[0].getsockname()[1]
 
     async def _on_connect(self, reader: asyncio.StreamReader,
@@ -289,8 +345,9 @@ def _decode_error(body: bytes) -> RaftException:
 
 
 class TcpClientTransport(ClientTransport):
-    def __init__(self, request_timeout_s: float = 30.0):
-        self._pool = _ConnectionPool()
+    def __init__(self, request_timeout_s: float = 30.0,
+                 tls: "TcpTlsConfig | None" = None):
+        self._pool = _ConnectionPool(tls=tls)
         self.request_timeout_s = request_timeout_s
 
     async def send_request(self, peer_address: str,
@@ -322,10 +379,11 @@ class TcpTransportFactory(TransportFactory):
                 properties).seconds
         return TcpServerTransport(peer_id, address, server_handler,
                                   client_handler, peer_resolver=peer_resolver,
-                                  request_timeout_s=timeout_s)
+                                  request_timeout_s=timeout_s,
+                                  tls=TcpTlsConfig.from_properties(properties))
 
     def new_client_transport(self, properties=None) -> ClientTransport:
-        return TcpClientTransport()
+        return TcpClientTransport(tls=TcpTlsConfig.from_properties(properties))
 
 
 TransportFactory.register("NETTY", TcpTransportFactory())

@@ -317,3 +317,157 @@ def test_scalar_batched_mode_crossing_invalidates_device_state():
         assert "stale" not in recs[1].events
 
     asyncio.run(_run())
+
+
+# ---------------------------------------------------------------- vote rounds
+
+
+def _setup_candidate(e: QuorumEngine, rec, n_peers=3, priorities=None,
+                     self_priority=0):
+    from ratis_tpu.engine.state import ROLE_CANDIDATE
+    slot = e.attach(rec)
+    s = e.state
+    cur = np.zeros(s.max_peers, bool)
+    cur[:n_peers] = True
+    prio = np.zeros(s.max_peers, np.int32)
+    if priorities is not None:
+        prio[:len(priorities)] = priorities
+    s.set_conf(slot, 0, cur, np.zeros(s.max_peers, bool), prio,
+               self_priority)
+    s.role[slot] = ROLE_CANDIDATE
+    s.mark_dirty(slot)
+    return slot
+
+
+def test_vote_round_passes_on_majority():
+    """Engine-tallied round (LeaderElection.waitForResults analog): self
+    grant + one peer grant = 2/3 majority -> PASSED at the next tick."""
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        slot = _setup_candidate(e, rec)
+        fut = e.begin_vote_round(slot, deadline_ms=10_000)
+        e.on_vote_reply(slot, 1, granted=True)
+        await e.tick()
+        assert fut.done() and fut.result() == "PASSED"
+
+    asyncio.run(run())
+
+
+def test_vote_round_rejected_by_majority():
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        slot = _setup_candidate(e, rec)
+        fut = e.begin_vote_round(slot, deadline_ms=10_000)
+        e.on_vote_reply(slot, 1, granted=False)
+        e.on_vote_reply(slot, 2, granted=False)
+        await e.tick()
+        assert fut.done() and fut.result() == "REJECTED"
+
+    asyncio.run(run())
+
+
+def test_vote_round_priority_veto_and_higher_priority_gate():
+    """A rejecting higher-priority peer vetoes instantly; an unresponsive
+    higher-priority peer blocks the strict pass until the round deadline
+    (LeaderElection.java:515-519,554-572)."""
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        # peer 1 has priority 5 > self 0; peer 2 same priority
+        slot = _setup_candidate(e, rec, priorities=[0, 5, 0])
+        fut = e.begin_vote_round(slot, deadline_ms=10_000)
+        e.on_vote_reply(slot, 2, granted=True)  # majority, but HP silent
+        await e.tick()
+        assert not fut.done()  # strict pass gated on the HP peer
+        e.clock.t = 10_001  # deadline fires -> passed_on_timeout
+        await e.tick()
+        assert fut.done() and fut.result() == "PASSED"
+
+        # a rejecting higher-priority peer is an unconditional veto
+        rec2 = Recorder()
+        slot2 = _setup_candidate(e, rec2, priorities=[0, 5, 0])
+        fut2 = e.begin_vote_round(slot2, deadline_ms=20_000)
+        e.on_vote_reply(slot2, 2, granted=True)
+        e.on_vote_reply(slot2, 1, granted=False)
+        await e.tick()
+        assert fut2.done() and fut2.result() == "REJECTED"
+
+    asyncio.run(run())
+
+
+def test_vote_round_timeout_without_majority():
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        slot = _setup_candidate(e, rec)
+        fut = e.begin_vote_round(slot, deadline_ms=500)
+        await e.tick()
+        assert not fut.done()
+        e.clock.t = 501
+        await e.tick()
+        assert fut.done() and fut.result() == "TIMEOUT"
+
+    asyncio.run(run())
+
+
+def test_vote_round_first_reply_wins_and_end_round():
+    """A flip-flopped duplicate reply must not double-count
+    (waitForResults responses.putIfAbsent); end_vote_round cancels."""
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        slot = _setup_candidate(e, rec)
+        fut = e.begin_vote_round(slot, deadline_ms=10_000)
+        e.on_vote_reply(slot, 1, granted=False)
+        e.on_vote_reply(slot, 1, granted=True)  # dup: dropped
+        await e.tick()
+        assert not fut.done()  # 1 grant (self) + 1 reject: undecided
+        e.end_vote_round(slot)
+        assert fut.cancelled()
+
+    asyncio.run(run())
+
+
+def test_vote_round_matches_scalar_oracle_randomized():
+    """Differential: the engine's batched tally must agree with the
+    ops.reference scalar tally for random grant/reject/priority mixes."""
+    from ratis_tpu.ops import reference as ref
+
+    async def run():
+        rng = random.Random(7)
+        for trial in range(40):
+            e = _mk_engine(use_device=True, max_groups=8, max_peers=4)
+            rec = Recorder()
+            n = rng.choice([3, 4])
+            priorities = [rng.choice([0, 0, 0, 3]) for _ in range(n)]
+            self_priority = priorities[0]
+            slot = _setup_candidate(e, rec, n_peers=n,
+                                    priorities=priorities,
+                                    self_priority=self_priority)
+            fut = e.begin_vote_round(slot, deadline_ms=1000)
+            grants = [False] * e.state.max_peers
+            rejects = [False] * e.state.max_peers
+            grants[0] = True
+            for peer in range(1, n):
+                verdict = rng.choice(["grant", "reject", "silent"])
+                if verdict == "grant":
+                    e.on_vote_reply(slot, peer, True)
+                    grants[peer] = True
+                elif verdict == "reject":
+                    e.on_vote_reply(slot, peer, False)
+                    rejects[peer] = True
+            e.clock.t = 1001  # force the deadline path for determinism
+            await e.tick()
+            conf_cur = [i < n for i in range(e.state.max_peers)]
+            conf_old = [False] * e.state.max_peers
+            prio = list(priorities) + [0] * (e.state.max_peers - n)
+            _, passed_on_timeout, rejected = ref.tally_votes(
+                grants, rejects, conf_cur, conf_old, prio, self_priority)
+            assert fut.done(), trial
+            expect = ("REJECTED" if rejected
+                      else "PASSED" if passed_on_timeout else "TIMEOUT")
+            assert fut.result() == expect, (trial, fut.result(), expect)
+
+    asyncio.run(run())

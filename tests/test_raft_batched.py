@@ -32,8 +32,10 @@ def test_batched_write_replicate_apply():
         # every tick went through the jitted kernel
         engines = [s.engine for s in cluster.servers.values()]
         assert all(e.metrics["batched_dispatches"] > 0 for e in engines)
+        # every non-idle tick went through the jitted kernel (no scalar
+        # fallback tick ever ran; idle ticks may skip the dispatch)
         assert all(e.metrics["ticks"] == e.metrics["batched_dispatches"]
-                   for e in engines)
+                   + e.metrics["idle_skips"] for e in engines)
         last = cluster.leaders()[0].state.log.get_last_committed_index()
         await cluster.wait_applied(last)
         for d in cluster.divisions():

@@ -286,3 +286,61 @@ def test_grpc_client_port_with_advertised_client_address():
                 await s.close()
 
     asyncio.run(main())
+
+
+def test_grpc_dedicated_admin_endpoint():
+    """Optional third gRPC server for the admin plane
+    (GrpcServicesImpl.java:56,197-224): admin operations are served on the
+    dedicated port; data-plane requests there are rejected."""
+    from ratis_tpu.conf.keys import GrpcConfigKeys
+
+    p = fast_properties()
+    admin_port = free_port()
+    p.set(GrpcConfigKeys.ADMIN_PORT_KEY, str(admin_port))
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        srv = cluster.servers[leader.member_id.peer_id]
+        assert srv.transport.bound_admin_port == admin_port
+
+        from ratis_tpu.protocol.admin import TransferLeadershipArguments
+        from ratis_tpu.protocol.exceptions import RaftException
+        from ratis_tpu.protocol.ids import ClientId
+        from ratis_tpu.protocol.message import Message
+        from ratis_tpu.protocol.requests import (RaftClientRequest,
+                                                 RequestType,
+                                                 admin_request_type,
+                                                 write_request_type)
+        from ratis_tpu.transport.grpc import GrpcClientTransport
+
+        host = srv.address.rsplit(":", 1)[0]
+        admin_addr = f"{host}:{admin_port}"
+        client = GrpcClientTransport()
+        try:
+            # GROUP_LIST (an admin type) served on the admin port
+            req = RaftClientRequest(
+                ClientId.random_id(), leader.member_id.peer_id,
+                cluster.group.group_id, 1, Message.EMPTY,
+                type=admin_request_type(RequestType.GROUP_LIST),
+                timeout_ms=3000)
+            reply = await client.send_request(admin_addr, req)
+            assert reply.success
+
+            # a data-plane WRITE is refused on the admin port
+            wreq = RaftClientRequest(
+                ClientId.random_id(), leader.member_id.peer_id,
+                cluster.group.group_id, 2,
+                Message.value_of(b"INCREMENT"),
+                type=write_request_type(), timeout_ms=3000)
+            try:
+                await client.send_request(admin_addr, wreq)
+                raise AssertionError("WRITE served on the admin port")
+            except RaftException:
+                pass
+        finally:
+            await client.close()
+        # ... while the normal endpoint still serves both
+        async with cluster.new_client() as c:
+            assert (await c.io().send(b"INCREMENT")).success
+
+    run_with_new_cluster(3, t, rpc_type="GRPC", properties=p)

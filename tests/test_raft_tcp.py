@@ -119,3 +119,61 @@ def test_tcp_datastream_combo():
                 == payload
 
     run_with_new_cluster(3, t, rpc_type=RPC, sm_factory=FileStoreStateMachine)
+
+
+def test_tcp_tls_cluster(tmp_path):
+    """TLS-secured raw-TCP transport (NettyConfigKeys.Tls): the cluster
+    elects and serves writes over TLS sockets, and a plaintext client
+    cannot talk to the TLS endpoint — no transport is plaintext-only."""
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True)
+
+    from minicluster import fast_properties
+    from ratis_tpu.conf.keys import NettyConfigKeys
+
+    p = fast_properties()
+    p.set(NettyConfigKeys.Tls.ENABLED_KEY, "true")
+    p.set(NettyConfigKeys.Tls.CERT_CHAIN_KEY, str(cert))
+    p.set(NettyConfigKeys.Tls.PRIVATE_KEY_KEY, str(key))
+    p.set(NettyConfigKeys.Tls.TRUST_ROOT_KEY, str(cert))
+
+    async def t(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            for i in range(1, 4):
+                r = await client.io().send(b"INCREMENT")
+                assert r.success
+                assert r.message.content == str(i).encode()
+
+        # a plaintext TCP client must fail against the TLS endpoint
+        from ratis_tpu.protocol.exceptions import (RaftException,
+                                                   TimeoutIOException)
+        from ratis_tpu.protocol.ids import ClientId
+        from ratis_tpu.protocol.message import Message
+        from ratis_tpu.protocol.requests import (RaftClientRequest,
+                                                 write_request_type)
+        from ratis_tpu.transport.tcp import TcpClientTransport
+        insecure = TcpClientTransport()
+        req = RaftClientRequest(ClientId.random_id(),
+                                leader.member_id.peer_id,
+                                cluster.group.group_id, 99,
+                                Message.value_of(b"INCREMENT"),
+                                type=write_request_type(), timeout_ms=2000)
+        srv = cluster.servers[leader.member_id.peer_id]
+        try:
+            await insecure.send_request(srv.address, req)
+            raise AssertionError("plaintext request succeeded against TLS")
+        except (RaftException, TimeoutIOException, ConnectionError, OSError):
+            pass
+        finally:
+            await insecure.close()
+
+    run_with_new_cluster(3, t, rpc_type="NETTY", properties=p)

@@ -130,3 +130,46 @@ def test_server_shutdown_notification():
             assert ("shutdown", True) in sm.events, sm.events
 
     run_with_new_cluster(3, body, sm_factory=EventRecordingSM)
+
+
+def test_apply_transaction_serial_runs_before_apply():
+    """apply_transaction_serial (StateMachine.java:565) is invoked by the
+    apply daemon strictly before apply_transaction for every committed
+    entry, in log-index order, and its (possibly transformed) context is
+    the one handed to apply_transaction."""
+    from ratis_tpu.models.counter import CounterStateMachine
+
+    class SerialRecordingSM(CounterStateMachine):
+        def __init__(self):
+            super().__init__()
+            self.calls = []
+
+        async def apply_transaction_serial(self, trx):
+            self.calls.append(("serial", trx.log_entry.index))
+            trx.serial_seen = True
+            return trx
+
+        async def apply_transaction(self, trx):
+            assert getattr(trx, "serial_seen", False), \
+                "apply_transaction ran without apply_transaction_serial"
+            self.calls.append(("apply", trx.log_entry.index))
+            return await super().apply_transaction(trx)
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        for _ in range(3):
+            assert (await cluster.send_write()).success
+        sm = leader.state_machine
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while asyncio.get_event_loop().time() < deadline:
+            if sum(1 for k, _ in sm.calls if k == "apply") >= 3:
+                break
+            await asyncio.sleep(0.05)
+        applies = [i for k, i in sm.calls if k == "apply"]
+        serials = [i for k, i in sm.calls if k == "serial"]
+        assert len(applies) >= 3
+        assert serials == sorted(serials), "serial hook ran out of order"
+        for idx in applies:
+            assert idx in serials
+
+    run_with_new_cluster(3, body, sm_factory=SerialRecordingSM)
