@@ -188,6 +188,15 @@ def main() -> None:
                               "writes": WRITES_PER_GROUP, "batched": False,
                               "concurrency": 128, "transport": "tcp"})
     scalar = _run_trials(scalar_spec, TRIALS)
+    # gRPC rung: proves the coalesced AppendEnvelope/BulkHeartbeat paths
+    # survive the grpc.aio transport (the reference's primary RPC stack
+    # analog) under load, batched vs scalar at 256 groups.
+    grpc_b = _run_trials(json.dumps({
+        "groups": 256, "writes": 8, "batched": True,
+        "concurrency": 128, "transport": "grpc"}), TRIALS)
+    grpc_s = _run_trials(json.dumps({
+        "groups": 256, "writes": 8, "batched": False,
+        "concurrency": 128, "transport": "grpc"}), TRIALS)
     kernel = _run_child(["--kernel-child"])
 
     def med(trials, key):
@@ -232,6 +241,13 @@ def main() -> None:
             "sim_ladder_convergence_s": {
                 str(g): _median([t["election_convergence_s"] for t in r])
                 for g, r in sorted(ladder.items())},
+            "grpc_256": {
+                "batched_commits_per_sec": _median(
+                    [t["commits_per_sec"] for t in grpc_b]),
+                "scalar_commits_per_sec": _median(
+                    [t["commits_per_sec"] for t in grpc_s]),
+                "batched_p99_ms": _median([t["p99_ms"] for t in grpc_b]),
+            },
             "kernel_group_updates_per_sec": kernel["group_updates_per_sec"],
             "kernel_vs_scalar_loop": kernel["vs_scalar_loop"],
             "kernel_platform": kernel["platform"],

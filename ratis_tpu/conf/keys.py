@@ -411,6 +411,11 @@ class RaftServerConfigKeys:
         SCALAR_FALLBACK_THRESHOLD_DEFAULT = 16  # below this many groups, skip device dispatch
         PLATFORM_KEY = "raft.tpu.engine.platform"
         PLATFORM_DEFAULT = ""  # "" = jax default platform
+        # Shard the resident engine state over this many local devices
+        # (jax.sharding.Mesh over the group axis; ratis_tpu.parallel.mesh).
+        # 0 = single-device.  The mesh size must divide max-groups.
+        MESH_DEVICES_KEY = "raft.tpu.engine.mesh-devices"
+        MESH_DEVICES_DEFAULT = 0
 
         @staticmethod
         def tick_interval(p: RaftProperties) -> TimeDuration:
@@ -426,6 +431,11 @@ class RaftServerConfigKeys:
         def max_peers(p: RaftProperties) -> int:
             return p.get_int(RaftServerConfigKeys.Engine.MAX_PEERS_KEY,
                              RaftServerConfigKeys.Engine.MAX_PEERS_DEFAULT)
+
+        @staticmethod
+        def mesh_devices(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Engine.MESH_DEVICES_KEY,
+                             RaftServerConfigKeys.Engine.MESH_DEVICES_DEFAULT)
 
 
 class GrpcConfigKeys:

@@ -35,6 +35,8 @@ _PACK_SENTINEL = -(2 ** 31)
 _SHARED_STEP = None
 _SHARED_FAST_STEP = None
 _SHARED_TALLY = None
+# (device ids, axis names) -> (refresh, fast) sharded jitted steps
+_SHARDED_STEPS: dict = {}
 
 
 def _shared_tally():
@@ -107,7 +109,14 @@ class QuorumEngine:
                  tick_interval_s: float = 0.002,
                  scalar_fallback_threshold: int = 16,
                  leadership_timeout_ms: int = 300,
-                 use_device: bool = False):
+                 use_device: bool = False,
+                 mesh=None):
+        # Optional jax.sharding.Mesh: the PRODUCTION resident tick
+        # (engine_step_resident / _fast, donated DeviceState) runs sharded
+        # over the group axis — each device owns G/n rows, packed events
+        # replicate, and the row-local quorum math keeps the step
+        # collective-free (ratis_tpu.parallel.mesh).
+        self.mesh = mesh
         self.state = GroupBatchState(max_groups, max_peers)
         self.clock = Clock()
         self.tick_interval_s = tick_interval_s
@@ -279,6 +288,19 @@ class QuorumEngine:
         fut = self._vote_rounds.pop(slot, None)
         if fut is not None and not fut.done():
             fut.cancel()
+
+    def expire_vote_round(self, slot: int) -> None:
+        """Every peer has replied or failed: pull the round deadline to now
+        so the next tick resolves it through the timeout-path tally — the
+        outstanding==0 early exit of the reference's waitForResults (a
+        majority gated only on a SILENT higher-priority peer must not wait
+        out the full randomized deadline once that peer's RPC has failed)."""
+        if slot in self._vote_rounds:
+            s = self.state
+            now = np.int32(self.clock.now_ms())
+            if s.vote_deadline_ms[slot] > now:
+                s.vote_deadline_ms[slot] = now
+            self._wake.set()
 
     def _vote_pass(self, now: int) -> list[tuple[asyncio.Future, str]]:
         """Apply queued vote replies and tally EVERY open round in one
@@ -534,8 +556,33 @@ class QuorumEngine:
         # One process-wide jitted step: the kernel is pure and every engine
         # in the process (one per co-hosted server) shares shapes, so a
         # shared wrapper compiles each shape bucket once instead of once
-        # per server.
+        # per server.  With a mesh, the per-engine sharded variants are
+        # used instead (same kernels, group axis partitioned).
+        if self.mesh is not None:
+            return self._mesh_steps()[0]
         return _shared_step()
+
+    def _fast_kernel(self):
+        if self.mesh is not None:
+            return self._mesh_steps()[1]
+        return _shared_fast_step()
+
+    def _mesh_steps(self):
+        # Process-wide like _shared_step: co-hosted servers build EQUAL
+        # meshes over the same devices, so keying by (devices, axes) lets
+        # one compile serve every engine (prewarming servers[0] covers the
+        # trio) instead of each engine landing its own synchronous compile
+        # mid-run.
+        key = (tuple(d.id for d in self.mesh.devices.flat),
+               self.mesh.axis_names)
+        steps = _SHARDED_STEPS.get(key)
+        if steps is None:
+            from ratis_tpu.parallel.mesh import (sharded_resident_fast_step,
+                                                 sharded_resident_step)
+            steps = (sharded_resident_step(self.mesh),
+                     sharded_resident_fast_step(self.mesh))
+            _SHARDED_STEPS[key] = steps
+        return steps
 
     def prewarm(self, group_counts=(64, 256, 1024),
                 event_counts=(64, 256, 1024)) -> None:
@@ -575,13 +622,17 @@ class QuorumEngine:
         import jax.numpy as jnp
         from ratis_tpu.ops import quorum as q
         s = self.state
-        return q.DeviceState(
+        dev = q.DeviceState(
             jnp.asarray(s.match_index), jnp.asarray(s.last_ack_ms),
             jnp.asarray(s.self_mask), jnp.asarray(s.conf_cur),
             jnp.asarray(s.conf_old), jnp.asarray(s.role),
             jnp.asarray(s.flush_index), jnp.asarray(s.commit_index),
             jnp.asarray(s.first_leader_index),
             jnp.asarray(s.election_deadline_ms))
+        if self.mesh is not None:
+            from ratis_tpu.parallel.mesh import shard_device_state
+            dev = shard_device_state(self.mesh, dev)
+        return dev
 
     @staticmethod
     def _pow2(n: int) -> int:
@@ -674,7 +725,7 @@ class QuorumEngine:
             # Flush advances and deadline re-arms travel as packed updates
             # alongside the acks, so routine traffic never needs a refresh.
             self.metrics["fast_ticks"] += 1
-            step = _shared_fast_step()
+            step = self._fast_kernel()
             updates, self._slot_updates = self._slot_updates, {}
             res = step(self._dev, jnp.asarray(self._pack_tick(acks, updates)),
                        jnp.asarray(np.array(

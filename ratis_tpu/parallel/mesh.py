@@ -80,9 +80,71 @@ def sharded_engine_step(mesh):
                    out_shardings=out_shardings)
 
 
+def device_state_shardings(mesh):
+    """Sharding for ops.quorum.DeviceState: every [G,...] array shards its
+    group axis over the mesh (row-local quorum math means the partitioner
+    keeps the resident step collective-free)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ratis_tpu.ops.quorum import DeviceState
+    grp = NamedSharding(mesh, P(GROUP_AXIS))
+    grp_peer = NamedSharding(mesh, P(GROUP_AXIS, None))
+    return DeviceState(
+        match_index=grp_peer, last_ack_ms=grp_peer, self_mask=grp_peer,
+        conf_cur=grp_peer, conf_old=grp_peer, role=grp,
+        flush_index=grp, commit_index=grp, first_leader_index=grp,
+        election_deadline_ms=grp)
+
+
+def sharded_resident_fast_step(mesh):
+    """jit(engine_step_resident_fast) with the DeviceState sharded over the
+    group axis, donated (the PRODUCTION steady-state tick, not the
+    stateless engine_step toy): packed events + meta replicate; the [4, G]
+    packed output shards its group axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ratis_tpu.ops.quorum import ResidentFastStep, engine_step_resident_fast
+    repl = NamedSharding(mesh, P())
+    out_grp = NamedSharding(mesh, P(None, GROUP_AXIS))
+    return jax.jit(
+        engine_step_resident_fast,
+        in_shardings=(device_state_shardings(mesh), repl, repl),
+        out_shardings=ResidentFastStep(device_state_shardings(mesh),
+                                       out_grp),
+        donate_argnums=(0,))
+
+
+def sharded_resident_step(mesh):
+    """jit(engine_step_resident): the dirty-row refresh variant of the
+    resident tick, DeviceState sharded + donated; refresh rows and packed
+    events replicate (the scatter by row index resolves locally)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ratis_tpu.ops.quorum import (DeviceState, ResidentStep,
+                                      engine_step_resident)
+    repl = NamedSharding(mesh, P())
+    grp = NamedSharding(mesh, P(GROUP_AXIS))
+    state_sh = device_state_shardings(mesh)
+    # state + 17 replicated inputs (rf rows, packed events, scalars)
+    in_shardings = (state_sh,) + (repl,) * 18
+    out_shardings = ResidentStep(state_sh, grp, grp, grp, grp)
+    return jax.jit(engine_step_resident, in_shardings=in_shardings,
+                   out_shardings=out_shardings, donate_argnums=(0,))
+
+
+def shard_device_state(mesh, state):
+    """device_put a DeviceState with its group-axis shardings."""
+    import jax
+    sh = device_state_shardings(mesh)
+    return type(state)(*(jax.device_put(a, s)
+                         for a, s in zip(state, sh)))
+
+
 def shard_batch(mesh, args: Sequence):
     """device_put every engine_step arg with its proper sharding; the group
-    count must divide the mesh size."""
+    axis size must be divisible by the mesh size."""
     import jax
     import jax.numpy as jnp
     in_shardings, _ = engine_shardings(mesh)

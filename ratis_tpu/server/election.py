@@ -268,6 +268,14 @@ class LeaderElection:
                 engine.on_vote_reply(slot, s, reply.granted)
 
         tasks = [asyncio.create_task(_one(p)) for p in others]
+
+        async def _all_replied():
+            # outstanding == 0: resolve now through the timeout-path tally
+            # instead of waiting out the randomized round deadline
+            await asyncio.gather(*tasks, return_exceptions=True)
+            engine.expire_vote_round(slot)
+
+        watcher = asyncio.create_task(_all_replied())
         try:
             result_str = await fut
         except asyncio.CancelledError:
@@ -281,6 +289,7 @@ class LeaderElection:
                     new_term, None, reason="higher term in vote reply")
             return result, new_term
         finally:
+            watcher.cancel()
             for t in tasks:
                 t.cancel()
         if self._stopped:

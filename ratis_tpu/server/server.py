@@ -207,6 +207,14 @@ class RaftServer:
         self.transactions: dict = {}
 
         p = properties
+        mesh = None
+        mesh_n = RaftServerConfigKeys.Engine.mesh_devices(p)
+        if mesh_n > 0:
+            # Multi-chip deployment: shard the resident engine state over
+            # the group axis of an n-device mesh (ratis_tpu.parallel.mesh;
+            # the row-local quorum math keeps the step collective-free).
+            from ratis_tpu.parallel import make_group_mesh
+            mesh = make_group_mesh(mesh_n)
         self.engine = QuorumEngine(
             max_groups=RaftServerConfigKeys.Engine.max_groups(p),
             max_peers=RaftServerConfigKeys.Engine.max_peers(p),
@@ -215,7 +223,8 @@ class RaftServer:
                 RaftServerConfigKeys.Engine.SCALAR_FALLBACK_THRESHOLD_KEY,
                 RaftServerConfigKeys.Engine.SCALAR_FALLBACK_THRESHOLD_DEFAULT),
             leadership_timeout_ms=int(
-                RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2))
+                RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2),
+            mesh=mesh)
         self.pause_monitor = None  # started in start() when enabled
         from ratis_tpu.conf.reconfiguration import ReconfigurationManager
         # live property reconfiguration (divisions register their knobs)

@@ -95,16 +95,21 @@ class BenchCluster:
         self.num_groups = num_groups
         self.batched = batched
         self.transport = transport
-        if transport == "tcp":
-            # Real localhost sockets (the netty-analog transport): every
-            # RPC pays framing + syscalls, so the per-(group,follower)
-            # stream shape costs what it costs the reference — the rung
-            # that proves the coalesced paths survive a real transport.
+        if transport in ("tcp", "grpc"):
+            # Real localhost sockets: every RPC pays framing + syscalls, so
+            # the per-(group,follower) stream shape costs what it costs the
+            # reference — the rungs that prove the coalesced paths
+            # (AppendEnvelope / BulkHeartbeat) survive a real transport.
+            # "tcp" is the netty-analog framed transport; "grpc" is the
+            # grpc.aio transport (reference's primary RPC stack analog).
             import socket
 
-            from ratis_tpu.transport.tcp import TcpTransportFactory
+            from ratis_tpu.transport.base import TransportFactory
+            import ratis_tpu.transport.grpc  # noqa: F401  (registers GRPC)
+            import ratis_tpu.transport.tcp  # noqa: F401  (registers TCP)
             self.network = None
-            self.factory = TcpTransportFactory()
+            self.factory = TransportFactory.get(
+                "GRPC" if transport == "grpc" else "TCP")
 
             def _port() -> int:
                 with socket.socket() as s:
@@ -114,12 +119,14 @@ class BenchCluster:
             peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
                               address=f"127.0.0.1:{_port()}")
                      for i in range(num_servers)]
-        else:
+        elif transport == "sim":
             self.network = SimulatedNetwork()
             self.factory = SimulatedTransportFactory(self.network)
             peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
                               address=f"sim:s{i}")
                      for i in range(num_servers)]
+        else:
+            raise ValueError(f"unknown bench transport {transport!r}")
         self.properties = bench_properties(batched, num_groups)
         self.groups = [RaftGroup.value_of(RaftGroupId.random_id(), peers)
                        for _ in range(num_groups)]

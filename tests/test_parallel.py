@@ -62,3 +62,122 @@ def test_dryrun_entry_points():
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
     dryrun_multichip(8)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_resident_engine_bit_identical(n_devices):
+    """The PRODUCTION resident path (QuorumEngine with mesh=..., donated
+    DeviceState sharded over the group axis) must be observationally
+    bit-identical to the same engine without a mesh: same state mirror,
+    same commit callbacks, same timeout firings, under a scripted
+    refresh + fast-tick + timeout scenario."""
+    import asyncio
+
+    from ratis_tpu.engine.engine import QuorumEngine
+    from ratis_tpu.engine.state import NO_DEADLINE, ROLE_FOLLOWER, ROLE_LEADER
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 0
+
+        def now_ms(self):
+            return self.t
+
+        def advance_epoch(self, delta_ms):
+            self.t -= delta_ms
+
+    class Rec:
+        def __init__(self):
+            self.events = []
+
+        def on_commit_advance_now(self, c):
+            self.events.append(("commit", c))
+
+        async def on_commit_advance(self, c):
+            self.events.append(("commit", c))
+
+        async def on_election_timeout(self):
+            self.events.append("timeout")
+
+        async def on_leadership_stale(self):
+            self.events.append("stale")
+
+    G = 16
+
+    def build(mesh):
+        eng = QuorumEngine(max_groups=G, max_peers=8,
+                           scalar_fallback_threshold=0, use_device=True,
+                           mesh=mesh)
+        eng.clock = FakeClock()
+        recs = []
+        s = eng.state
+        for i in range(G):
+            rec = Rec()
+            slot = eng.attach(rec)
+            recs.append((slot, rec))
+            cur = np.zeros(8, bool)
+            cur[:3] = True
+            s.set_conf(slot, 0, cur, np.zeros(8, bool),
+                       np.zeros(8, np.int32), 0)
+            if i % 2 == 0:
+                s.role[slot] = ROLE_LEADER
+                s.last_ack_ms[slot, :3] = 0
+            else:
+                s.role[slot] = ROLE_FOLLOWER
+                s.election_deadline_ms[slot] = 500 + i
+            s.mark_dirty(slot)
+        return eng, recs
+
+    async def drive(eng, recs):
+        await eng.tick()                       # dirty-row refresh pass
+        for slot, _ in recs[::2]:              # leaders: flush + quorum ack
+            eng.on_flush(slot, 7)
+            eng.on_ack(slot, 1, 7)
+        eng.clock.t = 100
+        await eng.tick()                       # fast pass
+        eng.clock.t = 600 + G                  # all follower deadlines past
+        await eng.tick()                       # timeout sweep
+        return eng, recs
+
+    async def run_pair():
+        mesh = make_group_mesh(n_devices)
+        e1, r1 = await drive(*build(mesh))
+        e2, r2 = await drive(*build(None))
+        for (s1, a), (s2, b) in zip(r1, r2):
+            assert a.events == b.events, (s1, a.events, b.events)
+        for name in ("match_index", "commit_index", "flush_index",
+                     "election_deadline_ms", "last_ack_ms"):
+            np.testing.assert_array_equal(
+                getattr(e1.state, name), getattr(e2.state, name),
+                err_msg=name)
+        # sharded run's resident state spans all devices
+        devs = {sh.device for sh in e1._dev.match_index.addressable_shards}
+        assert len(devs) == n_devices
+
+    asyncio.run(run_pair())
+
+
+def test_cluster_on_sharded_engine():
+    """A full cluster with raft.tpu.engine.mesh-devices=8: elections,
+    writes, and commit advancement all run through the group-sharded
+    donated resident state (the production multi-chip configuration)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from minicluster import MiniCluster, batched_properties, run_with_new_cluster
+    from ratis_tpu.conf.keys import RaftServerConfigKeys
+
+    p = batched_properties()
+    p.set(RaftServerConfigKeys.Engine.MESH_DEVICES_KEY, "8")
+    # mesh size must divide the group capacity; default 1024 % 8 == 0
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader(timeout=30)
+        srv = cluster.servers[leader.member_id.peer_id]
+        assert srv.engine.mesh is not None
+        for _ in range(5):
+            assert (await cluster.send_write()).success
+        devs = {sh.device
+                for sh in srv.engine._dev.match_index.addressable_shards}
+        assert len(devs) == 8, f"resident state on {len(devs)} devices"
+
+    run_with_new_cluster(3, body, properties=p)
