@@ -72,6 +72,37 @@ def child_e2e(spec: str) -> None:
     asyncio.run(main())
 
 
+def child_churn() -> None:
+    """BASELINE config 4 analog: leadership churn under load at 1024
+    groups (see ratis_tpu.tools.bench_cluster.run_churn_bench)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_churn_bench
+
+    async def main():
+        out = await run_churn_bench(1024, 8, transfers=64)
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
+def child_mixed() -> None:
+    """BASELINE config 5 analog: filestore writes + DataStream streams at
+    1024 groups (run_mixed_bench)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_mixed_bench
+
+    async def main():
+        out = await run_mixed_bench(1024, 4, streams=32,
+                                    stream_bytes=256 << 10)
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
 def child_kernel() -> None:
     import jax
     import jax.numpy as jnp
@@ -197,6 +228,8 @@ def main() -> None:
     grpc_s = _run_trials(json.dumps({
         "groups": 256, "writes": 8, "batched": False,
         "concurrency": 128, "transport": "grpc"}), TRIALS)
+    churn = _run_child(["--churn-child"], timeout_s=1200.0)
+    mixed = _run_child(["--mixed-child"], timeout_s=1200.0)
     kernel = _run_child(["--kernel-child"])
 
     def med(trials, key):
@@ -241,6 +274,17 @@ def main() -> None:
             "sim_ladder_convergence_s": {
                 str(g): _median([t["election_convergence_s"] for t in r])
                 for g, r in sorted(ladder.items())},
+            "churn_1024": {
+                "commits_per_sec": churn["commits_per_sec"],
+                "p99_ms": churn["p99_ms"],
+                "transfers_ok": churn["transfers_ok"],
+                "transfers_failed": churn["transfers_failed"],
+            },
+            "mixed_filestore_1024": {
+                "commits_per_sec": mixed["commits_per_sec"],
+                "streams_ok": mixed["streams_ok"],
+                "stream_mb_per_s": mixed["stream_mb_per_s"],
+            },
             "grpc_256": {
                 "batched_commits_per_sec": _median(
                     [t["commits_per_sec"] for t in grpc_b]),
@@ -260,5 +304,9 @@ if __name__ == "__main__":
         child_e2e(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--kernel-child":
         child_kernel()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--churn-child":
+        child_churn()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--mixed-child":
+        child_mixed()
     else:
         main()

@@ -75,6 +75,7 @@ class RaftClient:
         self.group = group
         self.group_id: RaftGroupId = group.group_id
         self.transport = transport
+        self.properties = properties  # e.g. datastream TLS config
         self.retry_policy = retry_policy or \
             RetryPolicies.retry_up_to_maximum_count_with_fixed_sleep(
                 50, TimeDuration.millis(100))
@@ -437,7 +438,10 @@ class DataStreamOutput:
         self.client = client
         self.request = request
         self.routing = routing
-        self._conn = DataStreamConnection(primary_address)
+        from ratis_tpu.conf.keys import NettyConfigKeys
+        tls = NettyConfigKeys.DataStreamTls.tls_config(
+            getattr(client, "properties", None))
+        self._conn = DataStreamConnection(primary_address, tls=tls)
         self._stream_id = request.type.stream_id
         self._offset = 0
         self._sem = asyncio.Semaphore(window)

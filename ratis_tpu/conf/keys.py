@@ -578,6 +578,57 @@ class NettyConfigKeys:
             return p.get_boolean(NettyConfigKeys.Tls.MUTUAL_AUTH_KEY,
                                  NettyConfigKeys.Tls.MUTUAL_AUTH_DEFAULT)
 
+    class DataStreamTls:
+        """TLS for the DataStream transport (reference NettyServerStreamRpc
+        takes its own TlsConfig, ratis-netty/.../NettyServerStreamRpc.java);
+        separate block because the stream plane often terminates TLS
+        differently from the RPC plane."""
+
+        ENABLED_KEY = "raft.datastream.tls.enabled"
+        ENABLED_DEFAULT = False
+        CERT_CHAIN_KEY = "raft.datastream.tls.cert.chain.path"
+        PRIVATE_KEY_KEY = "raft.datastream.tls.private.key.path"
+        TRUST_ROOT_KEY = "raft.datastream.tls.trust.root.path"
+        MUTUAL_AUTH_KEY = "raft.datastream.tls.mutual.auth.enabled"
+        MUTUAL_AUTH_DEFAULT = False
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(NettyConfigKeys.DataStreamTls.ENABLED_KEY,
+                                 NettyConfigKeys.DataStreamTls.ENABLED_DEFAULT)
+
+        @staticmethod
+        def cert_chain(p: RaftProperties):
+            return p.get(NettyConfigKeys.DataStreamTls.CERT_CHAIN_KEY)
+
+        @staticmethod
+        def private_key(p: RaftProperties):
+            return p.get(NettyConfigKeys.DataStreamTls.PRIVATE_KEY_KEY)
+
+        @staticmethod
+        def trust_root(p: RaftProperties):
+            return p.get(NettyConfigKeys.DataStreamTls.TRUST_ROOT_KEY)
+
+        @staticmethod
+        def mutual_auth(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                NettyConfigKeys.DataStreamTls.MUTUAL_AUTH_KEY,
+                NettyConfigKeys.DataStreamTls.MUTUAL_AUTH_DEFAULT)
+
+        @staticmethod
+        def tls_config(p):
+            """Build the stream-plane TLS config (or None when disabled);
+            the single source both the server (DataStreamManagement) and
+            the client (DataStreamOutput) construct from."""
+            if p is None or not NettyConfigKeys.DataStreamTls.enabled(p):
+                return None
+            from ratis_tpu.transport.tcp import TcpTlsConfig
+            K = NettyConfigKeys.DataStreamTls
+            return TcpTlsConfig(cert_chain_path=K.cert_chain(p),
+                                private_key_path=K.private_key(p),
+                                trust_root_path=K.trust_root(p),
+                                mutual_auth=K.mutual_auth(p))
+
 
 class RaftClientConfigKeys:
     PREFIX = "raft.client"

@@ -146,6 +146,9 @@ class QuorumEngine:
         # high group counts is the fixed per-dispatch overhead, not the
         # kernel).  0 forces the first dispatch.
         self._next_sweep_ms = 0
+        # A listener without the sync commit hook has an undelivered commit
+        # riding the tick path; the sweep gate must not skip while set.
+        self._tick_commit_pending = False
         self.metrics = {"ticks": 0, "acks": 0, "commit_advances": 0,
                         "batched_dispatches": 0, "refresh_rows": 0,
                         "fast_ticks": 0, "refresh_ticks": 0, "idle_skips": 0}
@@ -200,7 +203,10 @@ class QuorumEngine:
         listener = self._listeners.get(slot)
         cb = getattr(listener, "on_commit_advance_now", None)
         if cb is None:
-            self._wake.set()  # tick path owns this listener's commits
+            # tick path owns this listener's commits: force the next tick
+            # through the dispatch (the sweep gate must not skip it)
+            self._tick_commit_pending = True
+            self._wake.set()
             return
         new_commit, did = ref.update_commit(
             s.match_index[slot].tolist(), int(s.self_slot[slot]),
@@ -439,21 +445,43 @@ class QuorumEngine:
         self._dev = None  # wholesale time shift: re-upload the device state
         return now - delta
 
+    # Ack/flush backlog bound for the sweep-gated batched path: beyond this
+    # many queued events, ship them even with no sweep due (keeps the ring
+    # far below the chunking cap and the device's staleness inputs fresh).
+    _EVENT_BACKLOG_MAX = 8192
+
     async def tick(self) -> None:
         s = self.state
         now = self._maybe_rebase_epoch(self.clock.now_ms())
         self.metrics["ticks"] += 1
 
-        acks = self._ack_ring
-        self._ack_ring = []
-        self.metrics["acks"] += len(acks)
-
         active = s.active
         if not active:
+            self._ack_ring.clear()
             s.dirty.clear()
             self._slot_updates.clear()
             self._dev = None
             return
+
+        use_batched = (self.use_device
+                       or len(active) >= self.scalar_fallback_threshold)
+        if use_batched and self._dev is not None \
+                and not self._tick_commit_pending \
+                and not s.dirty and not self._vote_rounds \
+                and not self._vote_ring and now < self._next_sweep_ms \
+                and (len(self._ack_ring) + len(self._slot_updates)
+                     < self._EVENT_BACKLOG_MAX):
+            # Nothing the device could DECIDE right now: commits already
+            # advanced inline at intake, and no deadline/staleness sweep is
+            # due.  Let events accumulate — the next dispatch carries a
+            # bigger packed batch (the shape the kernel wants) and the
+            # engine's dispatch rate drops from per-tick to per-sweep.
+            self.metrics["idle_skips"] += 1
+            return
+
+        acks = self._ack_ring
+        self._ack_ring = []
+        self.metrics["acks"] += len(acks)
 
         # The host mirror was updated eagerly at ack intake (on_ack), where
         # the commit advance now happens inline; the events still travel to
@@ -463,14 +491,8 @@ class QuorumEngine:
         touched: set[int] = set(s.dirty)
         touched.update(a[0] for a in acks)
 
-        use_batched = (self.use_device
-                       or len(active) >= self.scalar_fallback_threshold)
         if use_batched:
-            if (not acks and not self._slot_updates and not s.dirty
-                    and not self._vote_rounds and not self._vote_ring
-                    and now < self._next_sweep_ms):
-                self.metrics["idle_skips"] += 1
-                return  # nothing to ship, no deadline/staleness sweep due
+            self._tick_commit_pending = False
             changed = self._tick_batched(acks, now)
             self._next_sweep_ms = self._compute_next_sweep(now)
         else:
@@ -483,6 +505,7 @@ class QuorumEngine:
             # it so a later crossing back over the threshold re-uploads
             s.dirty.clear()
             self._dev = None
+            self._tick_commit_pending = False
             changed = self._tick_scalar(touched, now)
 
         votes = (self._vote_pass(now)

@@ -127,7 +127,8 @@ def sharded_resident_step(mesh):
     repl = NamedSharding(mesh, P())
     grp = NamedSharding(mesh, P(GROUP_AXIS))
     state_sh = device_state_shardings(mesh)
-    # state + 17 replicated inputs (rf rows, packed events, scalars)
+    # state + 18 replicated inputs (11 refresh-row arrays, 5 packed
+    # event arrays, now_ms, leadership_timeout_ms)
     in_shardings = (state_sh,) + (repl,) * 18
     out_shardings = ResidentStep(state_sh, grp, grp, grp, grp)
     return jax.jit(engine_step_resident, in_shardings=in_shardings,

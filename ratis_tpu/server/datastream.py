@@ -54,10 +54,10 @@ class StreamInfo:
 class _RemoteStream:
     """Forwarding leg to one successor (reference RemoteStream)."""
 
-    def __init__(self, peer_id: RaftPeerId, address: str) -> None:
+    def __init__(self, peer_id: RaftPeerId, address: str, tls=None) -> None:
         self.peer_id = peer_id
         self.address = address
-        self.conn = DataStreamConnection(address)
+        self.conn = DataStreamConnection(address, tls=tls)
 
     async def connect(self) -> None:
         await self.conn.connect()
@@ -82,7 +82,11 @@ class DataStreamManagement:
     def __init__(self, server, address: str,
                  expiry_s: float = 300.0) -> None:
         self.server = server  # RaftServer
-        self.transport = DataStreamServer(address, self._on_packet)
+        from ratis_tpu.conf.keys import NettyConfigKeys
+        self.tls = NettyConfigKeys.DataStreamTls.tls_config(
+            server.properties)
+        self.transport = DataStreamServer(address, self._on_packet,
+                                          tls=self.tls)
         # streamId -> StreamInfo while streaming (ids are client-random
         # 64-bit, collision-free in practice)
         self._streams: Dict[int, StreamInfo] = {}
@@ -181,7 +185,8 @@ class DataStreamManagement:
             if peer is None or not peer.datastream_address:
                 raise DataStreamException(
                     f"successor {pid} has no datastream address")
-            remotes.append(_RemoteStream(pid, peer.datastream_address))
+            remotes.append(_RemoteStream(pid, peer.datastream_address,
+                             tls=self.tls))
 
         info = StreamInfo(request, is_primary, local, remotes)
         self._streams[packet.stream_id] = info

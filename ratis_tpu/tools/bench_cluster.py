@@ -48,11 +48,13 @@ def bench_properties(batched: bool, num_groups: int = 1) -> RaftProperties:
     # comparison is unaffected.
     if num_groups >= 8192:
         RaftServerConfigKeys.Rpc.set_timeout(p, "8s", "16s")
-    elif num_groups >= 4096:
+    elif num_groups >= 2048:
         RaftServerConfigKeys.Rpc.set_timeout(p, "4s", "8s")
-    elif num_groups >= 512:
-        RaftServerConfigKeys.Rpc.set_timeout(p, "2s", "4s")
     else:
+        # 1s/2s at <=1024 groups: already ~7x the reference's default
+        # election timeouts (150-300ms, RaftServerConfigKeys.java) — the
+        # baseline's per-(group,follower) heartbeat channels get a generous
+        # but realistic idle cadence.
         RaftServerConfigKeys.Rpc.set_timeout(p, "1s", "2s")
     if batched:
         # Commits advance inline at ack intake (QuorumEngine.on_ack), so
@@ -91,10 +93,13 @@ class BenchCluster:
     """A 3-server in-process trio hosting ``num_groups`` sibling groups."""
 
     def __init__(self, num_groups: int, num_servers: int = 3,
-                 batched: bool = True, transport: str = "sim"):
+                 batched: bool = True, transport: str = "sim",
+                 sm: str = "counter", datastream: bool = False):
         self.num_groups = num_groups
         self.batched = batched
         self.transport = transport
+        self.sm = sm
+        self.datastream = datastream
         if transport in ("tcp", "grpc"):
             # Real localhost sockets: every RPC pays framing + syscalls, so
             # the per-(group,follower) stream shape costs what it costs the
@@ -117,26 +122,46 @@ class BenchCluster:
                     return s.getsockname()[1]
 
             peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
-                              address=f"127.0.0.1:{_port()}")
+                              address=f"127.0.0.1:{_port()}",
+                              datastream_address=(f"127.0.0.1:{_port()}"
+                                                  if datastream else None))
                      for i in range(num_servers)]
         elif transport == "sim":
+            import socket
+
+            def _dport() -> int:
+                with socket.socket() as sk:
+                    sk.bind(("127.0.0.1", 0))
+                    return sk.getsockname()[1]
+
             self.network = SimulatedNetwork()
             self.factory = SimulatedTransportFactory(self.network)
             peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
-                              address=f"sim:s{i}")
+                              address=f"sim:s{i}",
+                              datastream_address=(f"127.0.0.1:{_dport()}"
+                                                  if datastream else None))
                      for i in range(num_servers)]
         else:
             raise ValueError(f"unknown bench transport {transport!r}")
         self.properties = bench_properties(batched, num_groups)
         self.groups = [RaftGroup.value_of(RaftGroupId.random_id(), peers)
                        for _ in range(num_groups)]
+        if sm == "filestore":
+            from ratis_tpu.models.filestore import FileStoreStateMachine
+
+            def _sm_factory():
+                return FileStoreStateMachine()
+        else:
+            def _sm_factory():
+                return CounterStateMachine()
         self.servers: list[RaftServer] = [
             RaftServer(p.id, p.address,
-                       state_machine_registry=lambda gid: CounterStateMachine(),
+                       state_machine_registry=lambda gid: _sm_factory(),
                        properties=self.properties,
                        transport_factory=self.factory,
                        group=self.groups[0])
             for p in peers]
+        self.peers = peers
         self._call_ids = itertools.count(1)
         self.election_convergence_s: float = 0.0
         self.prewarm_s: float = 0.0
@@ -228,14 +253,14 @@ class BenchCluster:
     # ------------------------------------------------------------- workload
 
     async def _write(self, client, client_id: ClientId, gid: RaftGroupId,
-                     timeout: float = 60.0):
-        """One counter INCREMENT with leader-hint failover."""
+                     timeout: float = 60.0, message: bytes = b"INCREMENT"):
+        """One write with leader-hint failover."""
         server = self._leader_hint.get(gid, self.servers[0])
         deadline = time.monotonic() + timeout
         while True:
             req = RaftClientRequest(client_id, server.peer_id, gid,
                                     next(self._call_ids),
-                                    Message.value_of(b"INCREMENT"),
+                                    Message.value_of(message),
                                     type=write_request_type())
             try:
                 reply = await client.send_request(server.address, req)
@@ -259,10 +284,12 @@ class BenchCluster:
                 await asyncio.sleep(0.01)
 
     async def run_load(self, writes_per_group: int,
-                       concurrency: int = 256) -> dict:
+                       concurrency: int = 256,
+                       message_factory=None) -> dict:
         """Drive writes_per_group sequential writes per group, groups
         concurrent under a global in-flight bound; returns throughput and
-        latency percentiles."""
+        latency percentiles.  ``message_factory`` builds per-write payloads
+        (default: the counter INCREMENT)."""
         client = self.factory.new_client_transport()
         sem = asyncio.Semaphore(concurrency)
         latencies: list[float] = []
@@ -271,8 +298,11 @@ class BenchCluster:
             client_id = ClientId.random_id()
             for _ in range(writes_per_group):
                 async with sem:
+                    msg = (message_factory() if message_factory is not None
+                           else b"INCREMENT")
                     t0 = time.monotonic()
-                    await self._write(client, client_id, g.group_id)
+                    await self._write(client, client_id, g.group_id,
+                                      message=msg)
                     latencies.append(time.monotonic() - t0)
 
         t_start = time.monotonic()
@@ -293,29 +323,38 @@ class BenchCluster:
         }
 
 
+
+
+import contextlib
+
+
+@contextlib.asynccontextmanager
+async def _started_cluster(num_groups: int, batched: bool,
+                           transport: str = "sim", sm: str = "counter",
+                           datastream: bool = False):
+    """Shared rung scaffold: build + start the cluster with the GC tuning
+    every rung needs (defer gen-2 cascades during bring-up, then freeze the
+    post-bring-up heap out of the collector — a single gen-2 pass over the
+    10k-group live heap measured 52s; the pause monitor caught it)."""
+    import gc
+    gc.set_threshold(700, 1000, 1000)
+    cluster = BenchCluster(num_groups, batched=batched, transport=transport,
+                           sm=sm, datastream=datastream)
+    try:
+        await cluster.start()
+        gc.collect()
+        gc.freeze()
+        yield cluster
+    finally:
+        await cluster.close()
+
+
 async def run_bench(num_groups: int, writes_per_group: int,
                     batched: bool = True, concurrency: int = 256,
                     warmup_writes: int = 1, transport: str = "sim") -> dict:
     """One ladder rung: build the trio, elect, warm up, measure, tear down."""
-    import gc
-    # Defer gen-2 cascades during bring-up (30k divisions allocated while
-    # transient asyncio objects churn gen-0); gen-0 stays at its default so
-    # short-lived cycles are still reclaimed promptly.
-    gc.set_threshold(700, 1000, 1000)
-    cluster = BenchCluster(num_groups, batched=batched, transport=transport)
-    try:
-        await cluster.start()
-        # GC hygiene for a multi-GB live heap: at 10k groups the cluster
-        # holds ~30k divisions of long-lived objects; CPython's gen-2
-        # collections rescan all of it on a cadence driven by transient
-        # allocation (a single pass measured 52s at 10240 groups — the
-        # event loop pause monitor caught it).  Freeze the post-bring-up
-        # heap out of the collector and keep gen-0/1 small-object cycling
-        # cheap.  (The JVM reference needs the analogous tuning; its
-        # JvmPauseMonitor exists precisely because GC stalls look like
-        # dead peers.)
-        gc.collect()
-        gc.freeze()
+    async with _started_cluster(num_groups, batched,
+                                transport=transport) as cluster:
         if warmup_writes:
             await cluster.run_load(warmup_writes, concurrency)
         result = await cluster.run_load(writes_per_group, concurrency)
@@ -327,5 +366,133 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport
         return result
-    finally:
-        await cluster.close()
+
+
+async def run_churn_bench(num_groups: int, writes_per_group: int,
+                          transfers: int, batched: bool = True,
+                          concurrency: int = 128) -> dict:
+    """BASELINE config 4 analog: reconfig/leadership churn under load.
+
+    Drives the normal write load while a churn task performs ``transfers``
+    leadership transfers (the reference's TransferLeadership admin path)
+    on randomly chosen groups; measures how throughput and tail latency
+    hold up while leaderships move underneath the clients."""
+    import random
+
+    from ratis_tpu.protocol.admin import TransferLeadershipArguments
+    from ratis_tpu.protocol.requests import RequestType, admin_request_type
+
+    async with _started_cluster(num_groups, batched) as cluster:
+        client = cluster.factory.new_client_transport()
+        rng = random.Random(17)
+        churn_stats = {"ok": 0, "failed": 0}
+
+        async def churn():
+            client_id = ClientId.random_id()
+            for _ in range(transfers):
+                g = rng.choice(cluster.groups)
+                leader_srv = cluster._leader_hint.get(g.group_id,
+                                                      cluster.servers[0])
+                target = rng.choice(
+                    [p.id for p in g.peers if p.id != leader_srv.peer_id])
+                args = TransferLeadershipArguments(str(target), 3000.0)
+                req = RaftClientRequest(
+                    client_id, leader_srv.peer_id, g.group_id,
+                    next(cluster._call_ids), Message(args.to_payload()),
+                    type=admin_request_type(RequestType.TRANSFER_LEADERSHIP),
+                    timeout_ms=5000.0)
+                try:
+                    reply = await client.send_request(leader_srv.address, req)
+                    if reply.success:
+                        churn_stats["ok"] += 1
+                        cluster._leader_hint.pop(g.group_id, None)
+                    else:
+                        churn_stats["failed"] += 1
+                except Exception:
+                    churn_stats["failed"] += 1
+                await asyncio.sleep(0.02)
+
+        churn_task = asyncio.create_task(churn())
+        result = await cluster.run_load(writes_per_group, concurrency)
+        await churn_task
+        result["groups"] = num_groups
+        result["mode"] = "batched" if batched else "scalar"
+        result["transfers_ok"] = churn_stats["ok"]
+        result["transfers_failed"] = churn_stats["failed"]
+        return result
+
+
+async def run_mixed_bench(num_groups: int, writes_per_group: int,
+                          streams: int, stream_bytes: int,
+                          batched: bool = True,
+                          concurrency: int = 128) -> dict:
+    """BASELINE config 5 analog: filestore + DataStream mixed load.
+
+    Every group runs a FileStore state machine; the bulk load is ordinary
+    log-path file writes, while ``streams`` concurrent DataStream file
+    streams (stream_bytes each) ride the out-of-band stream plane into a
+    subset of groups (ratis-examples filestore LoadGen's mixed mode)."""
+    import msgpack
+
+    from ratis_tpu.client import RaftClient
+
+    async with _started_cluster(num_groups, batched, sm="filestore",
+                                datastream=True) as cluster:
+        stream_stats = {"ok": 0, "failed": 0, "bytes": 0, "elapsed_s": 0.0}
+        payload = b"\x5a" * stream_bytes
+
+        async def one_stream(i: int):
+            g = cluster.groups[i % len(cluster.groups)]
+            client = (RaftClient.builder()
+                      .set_raft_group(g)
+                      .set_transport(cluster.factory.new_client_transport(
+                          cluster.properties))
+                      .set_properties(cluster.properties)
+                      .build())
+            try:
+                cmd = msgpack.packb({"op": "stream",
+                                     "path": f"stream-{i}.bin"},
+                                    use_bin_type=True)
+                out = await client.data_stream().stream(cmd)
+                for off in range(0, stream_bytes, 64 << 10):
+                    await out.write_async(payload[off:off + (64 << 10)])
+                reply = await out.close_async()
+                if reply.success:
+                    stream_stats["ok"] += 1
+                    stream_stats["bytes"] += stream_bytes
+                else:
+                    stream_stats["failed"] += 1
+            except Exception:
+                stream_stats["failed"] += 1
+            finally:
+                await client.close()
+
+        async def stream_load():
+            # stream bandwidth is timed over the STREAM work only, not the
+            # (longer) concurrent write load
+            t0 = time.monotonic()
+            sem = asyncio.Semaphore(8)
+
+            async def bounded(i):
+                async with sem:
+                    await one_stream(i)
+
+            await asyncio.gather(*(bounded(i) for i in range(streams)))
+            stream_stats["elapsed_s"] = time.monotonic() - t0
+
+        seq = itertools.count()
+        msg_factory = lambda: msgpack.packb(
+            {"op": "write", "path": f"w{next(seq)}", "data": b"x" * 128},
+            use_bin_type=True)
+        stream_task = asyncio.create_task(stream_load())
+        result = await cluster.run_load(writes_per_group, concurrency,
+                                        message_factory=msg_factory)
+        await stream_task
+        result["groups"] = num_groups
+        result["mode"] = "batched" if batched else "scalar"
+        result["streams_ok"] = stream_stats["ok"]
+        result["streams_failed"] = stream_stats["failed"]
+        result["stream_mb_per_s"] = round(
+            stream_stats["bytes"]
+            / max(stream_stats["elapsed_s"], 1e-9) / (1 << 20), 2)
+        return result

@@ -23,10 +23,11 @@ def main():
     groups = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
     writes = int(sys.argv[3]) if len(sys.argv) > 3 else 8
     batched = (sys.argv[2] != "scalar") if len(sys.argv) > 2 else True
+    transport = sys.argv[4] if len(sys.argv) > 4 else "sim"
     from ratis_tpu.tools.bench_cluster import BenchCluster
 
     async def run():
-        cluster = BenchCluster(groups, batched=batched)
+        cluster = BenchCluster(groups, batched=batched, transport=transport)
         try:
             await cluster.start()
             await cluster.run_load(1, 128)  # warmup

@@ -128,16 +128,19 @@ class PeerConnection:
 class DataStreamServer:
     """Accept loop dispatching packets to a handler (NettyServerStreamRpc)."""
 
-    def __init__(self, address: str, handler: PacketHandler) -> None:
+    def __init__(self, address: str, handler: PacketHandler,
+                 tls=None) -> None:
         self.address = address
         self.handler = handler
+        self.tls = tls  # transport.tcp.TcpTlsConfig (same surface)
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[PeerConnection] = set()
 
     async def start(self) -> None:
         host, port = self.address.rsplit(":", 1)
+        ssl_ctx = self.tls.server_context() if self.tls is not None else None
         self._server = await asyncio.start_server(self._on_connect, host,
-                                                  int(port))
+                                                  int(port), ssl=ssl_ctx)
 
     @property
     def bound_port(self) -> Optional[int]:
@@ -182,8 +185,9 @@ class DataStreamConnection:
     keyed by (stream_id, offset, close-flag) — the sliding-window analog of
     OrderedStreamAsync."""
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str, tls=None) -> None:
         self.address = address
+        self.tls = tls
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[tuple, asyncio.Future] = {}
@@ -193,8 +197,9 @@ class DataStreamConnection:
 
     async def connect(self) -> None:
         host, port = self.address.rsplit(":", 1)
+        ssl_ctx = self.tls.client_context() if self.tls is not None else None
         self._reader, self._writer = await asyncio.open_connection(
-            host, int(port))
+            host, int(port), ssl=ssl_ctx)
         self._recv_task = asyncio.create_task(
             self._recv_loop(), name=f"datastream-recv-{self.address}")
 

@@ -198,6 +198,7 @@ class MiniCluster:
                 .set_transport(
                     self.factory.new_client_transport(self.properties))
                 .set_retry_policy(retry_policy)
+                .set_properties(self.properties)
                 .build())
 
     async def add_new_server(self, peer: RaftPeer,

@@ -129,12 +129,22 @@ def test_sharded_resident_engine_bit_identical(n_devices):
         return eng, recs
 
     async def drive(eng, recs):
-        await eng.tick()                       # dirty-row refresh pass
+        await eng.tick()  # first dispatch: full upload absorbs the dirt
         for slot, _ in recs[::2]:              # leaders: flush + quorum ack
             eng.on_flush(slot, 7)
             eng.on_ack(slot, 1, 7)
         eng.clock.t = 100
         await eng.tick()                       # fast pass
+        # Mark rows dirty BETWEEN ticks so the next dispatch exercises the
+        # dirty-row REFRESH kernel (sharded_resident_step) — without this
+        # the first upload absorbs all dirt and only the fast path runs.
+        s = eng.state
+        for slot, _ in recs[:4]:
+            s.match_index[slot, 2] = 3
+            s.mark_dirty(slot)
+        eng.clock.t = 200
+        await eng.tick()                       # refresh pass
+        assert eng.metrics["refresh_ticks"] > 0
         eng.clock.t = 600 + G                  # all follower deadlines past
         await eng.tick()                       # timeout sweep
         return eng, recs
