@@ -66,7 +66,8 @@ def child_e2e(spec: str) -> None:
                               batched=cfg["batched"],
                               concurrency=cfg.get("concurrency", 128),
                               warmup_writes=cfg.get("warmup", 1),
-                              transport=cfg.get("transport", "sim"))
+                              transport=cfg.get("transport", "sim"),
+                              sm=cfg.get("sm", "counter"))
         print("RESULT " + json.dumps(out))
 
     asyncio.run(main())
@@ -223,10 +224,10 @@ def main() -> None:
     # survive the grpc.aio transport (the reference's primary RPC stack
     # analog) under load, batched vs scalar at 256 groups.
     grpc_b = _run_trials(json.dumps({
-        "groups": 256, "writes": 8, "batched": True,
+        "groups": 256, "writes": 8, "batched": True, "sm": "arithmetic",
         "concurrency": 128, "transport": "grpc"}), TRIALS)
     grpc_s = _run_trials(json.dumps({
-        "groups": 256, "writes": 8, "batched": False,
+        "groups": 256, "writes": 8, "batched": False, "sm": "arithmetic",
         "concurrency": 128, "transport": "grpc"}), TRIALS)
     churn = _run_child(["--churn-child"], timeout_s=1200.0)
     mixed = _run_child(["--mixed-child"], timeout_s=1200.0)

@@ -17,9 +17,18 @@ metrics from BASELINE.md.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import itertools
 import time
 from typing import Optional
+
+
+def _ephemeral_port() -> int:
+    """Ask the kernel for a currently-free localhost port."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys
 from ratis_tpu.models.counter import CounterStateMachine
@@ -107,39 +116,26 @@ class BenchCluster:
             # (AppendEnvelope / BulkHeartbeat) survive a real transport.
             # "tcp" is the netty-analog framed transport; "grpc" is the
             # grpc.aio transport (reference's primary RPC stack analog).
-            import socket
-
             from ratis_tpu.transport.base import TransportFactory
             import ratis_tpu.transport.grpc  # noqa: F401  (registers GRPC)
             import ratis_tpu.transport.tcp  # noqa: F401  (registers TCP)
             self.network = None
             self.factory = TransportFactory.get(
                 "GRPC" if transport == "grpc" else "TCP")
-
-            def _port() -> int:
-                with socket.socket() as s:
-                    s.bind(("127.0.0.1", 0))
-                    return s.getsockname()[1]
-
             peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
-                              address=f"127.0.0.1:{_port()}",
-                              datastream_address=(f"127.0.0.1:{_port()}"
-                                                  if datastream else None))
+                              address=f"127.0.0.1:{_ephemeral_port()}",
+                              datastream_address=(
+                                  f"127.0.0.1:{_ephemeral_port()}"
+                                  if datastream else None))
                      for i in range(num_servers)]
         elif transport == "sim":
-            import socket
-
-            def _dport() -> int:
-                with socket.socket() as sk:
-                    sk.bind(("127.0.0.1", 0))
-                    return sk.getsockname()[1]
-
             self.network = SimulatedNetwork()
             self.factory = SimulatedTransportFactory(self.network)
             peers = [RaftPeer(RaftPeerId.value_of(f"s{i}"),
                               address=f"sim:s{i}",
-                              datastream_address=(f"127.0.0.1:{_dport()}"
-                                                  if datastream else None))
+                              datastream_address=(
+                                  f"127.0.0.1:{_ephemeral_port()}"
+                                  if datastream else None))
                      for i in range(num_servers)]
         else:
             raise ValueError(f"unknown bench transport {transport!r}")
@@ -151,6 +147,11 @@ class BenchCluster:
 
             def _sm_factory():
                 return FileStoreStateMachine()
+        elif sm == "arithmetic":
+            from ratis_tpu.models.arithmetic import ArithmeticStateMachine
+
+            def _sm_factory():
+                return ArithmeticStateMachine()
         else:
             def _sm_factory():
                 return CounterStateMachine()
@@ -161,7 +162,6 @@ class BenchCluster:
                        transport_factory=self.factory,
                        group=self.groups[0])
             for p in peers]
-        self.peers = peers
         self._call_ids = itertools.count(1)
         self.election_convergence_s: float = 0.0
         self.prewarm_s: float = 0.0
@@ -325,9 +325,6 @@ class BenchCluster:
 
 
 
-import contextlib
-
-
 @contextlib.asynccontextmanager
 async def _started_cluster(num_groups: int, batched: bool,
                            transport: str = "sim", sm: str = "counter",
@@ -351,13 +348,22 @@ async def _started_cluster(num_groups: int, batched: bool,
 
 async def run_bench(num_groups: int, writes_per_group: int,
                     batched: bool = True, concurrency: int = 256,
-                    warmup_writes: int = 1, transport: str = "sim") -> dict:
+                    warmup_writes: int = 1, transport: str = "sim",
+                    sm: str = "counter") -> dict:
     """One ladder rung: build the trio, elect, warm up, measure, tear down."""
-    async with _started_cluster(num_groups, batched,
-                                transport=transport) as cluster:
+    async with _started_cluster(num_groups, batched, transport=transport,
+                                sm=sm) as cluster:
+        mf = None
+        if sm == "arithmetic":
+            # BASELINE config 2's workload shape: var = expression writes
+            import itertools as _it
+            seq = _it.count()
+            mf = lambda: f"v{next(seq) % 7}={next(seq) % 97}+1".encode()
         if warmup_writes:
-            await cluster.run_load(warmup_writes, concurrency)
-        result = await cluster.run_load(writes_per_group, concurrency)
+            await cluster.run_load(warmup_writes, concurrency,
+                                   message_factory=mf)
+        result = await cluster.run_load(writes_per_group, concurrency,
+                                        message_factory=mf)
         engines = [s.engine for s in cluster.servers]
         result["batched_dispatches"] = sum(
             e.metrics["batched_dispatches"] for e in engines)
