@@ -67,7 +67,8 @@ def child_e2e(spec: str) -> None:
                               concurrency=cfg.get("concurrency", 128),
                               warmup_writes=cfg.get("warmup", 1),
                               transport=cfg.get("transport", "sim"),
-                              sm=cfg.get("sm", "counter"))
+                              sm=cfg.get("sm", "counter"),
+                              num_servers=cfg.get("peers", 3))
         print("RESULT " + json.dumps(out))
 
     asyncio.run(main())

@@ -328,14 +328,15 @@ class BenchCluster:
 @contextlib.asynccontextmanager
 async def _started_cluster(num_groups: int, batched: bool,
                            transport: str = "sim", sm: str = "counter",
-                           datastream: bool = False):
+                           datastream: bool = False, num_servers: int = 3):
     """Shared rung scaffold: build + start the cluster with the GC tuning
     every rung needs (defer gen-2 cascades during bring-up, then freeze the
     post-bring-up heap out of the collector — a single gen-2 pass over the
     10k-group live heap measured 52s; the pause monitor caught it)."""
     import gc
     gc.set_threshold(700, 1000, 1000)
-    cluster = BenchCluster(num_groups, batched=batched, transport=transport,
+    cluster = BenchCluster(num_groups, num_servers=num_servers,
+                           batched=batched, transport=transport,
                            sm=sm, datastream=datastream)
     try:
         await cluster.start()
@@ -349,10 +350,10 @@ async def _started_cluster(num_groups: int, batched: bool,
 async def run_bench(num_groups: int, writes_per_group: int,
                     batched: bool = True, concurrency: int = 256,
                     warmup_writes: int = 1, transport: str = "sim",
-                    sm: str = "counter") -> dict:
+                    sm: str = "counter", num_servers: int = 3) -> dict:
     """One ladder rung: build the trio, elect, warm up, measure, tear down."""
     async with _started_cluster(num_groups, batched, transport=transport,
-                                sm=sm) as cluster:
+                                sm=sm, num_servers=num_servers) as cluster:
         mf = None
         if sm == "arithmetic":
             # BASELINE config 2's workload shape: var = expression writes
@@ -371,6 +372,7 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport
+        result["peers"] = num_servers
         return result
 
 
