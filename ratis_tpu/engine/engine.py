@@ -530,12 +530,16 @@ class QuorumEngine:
     def _compute_next_sweep(self, now: int) -> int:
         """Earliest time the device must be consulted again with no new
         events: the soonest armed follower deadline, bounded by the
-        staleness-sweep cadence (timeout/4, matching the scalar path)."""
+        staleness-sweep cadence (timeout/4, matching the scalar path) —
+        but only when this server leads anything (a follower-only or idle
+        server has no leaderships to check for staleness)."""
         s = self.state
         dl = np.where(s.role == ROLE_FOLLOWER, s.election_deadline_ms,
                       NO_DEADLINE)
         nxt = int(dl.min()) if dl.size else NO_DEADLINE
-        return min(nxt, now + max(1, self.leadership_timeout_ms // 4))
+        if bool((s.role == ROLE_LEADER).any()):
+            nxt = min(nxt, now + max(1, self.leadership_timeout_ms // 4))
+        return nxt
 
     # -- scalar path ---------------------------------------------------------
 

@@ -99,7 +99,8 @@ def bench_properties(batched: bool, num_groups: int = 1) -> RaftProperties:
 
 
 class BenchCluster:
-    """A 3-server in-process trio hosting ``num_groups`` sibling groups."""
+    """An in-process ``num_servers``-server cluster (default 3) hosting
+    ``num_groups`` sibling groups."""
 
     def __init__(self, num_groups: int, num_servers: int = 3,
                  batched: bool = True, transport: str = "sim",
@@ -351,7 +352,8 @@ async def run_bench(num_groups: int, writes_per_group: int,
                     batched: bool = True, concurrency: int = 256,
                     warmup_writes: int = 1, transport: str = "sim",
                     sm: str = "counter", num_servers: int = 3) -> dict:
-    """One ladder rung: build the trio, elect, warm up, measure, tear down."""
+    """One ladder rung: build the ``num_servers``-server cluster, elect,
+    warm up, measure, tear down."""
     async with _started_cluster(num_groups, batched, transport=transport,
                                 sm=sm, num_servers=num_servers) as cluster:
         mf = None

@@ -471,3 +471,66 @@ def test_vote_round_matches_scalar_oracle_randomized():
             assert fut.result() == expect, (trial, fut.result(), expect)
 
     asyncio.run(run())
+
+
+def test_sweep_gate_does_not_delay_election_timeout():
+    """The sweep-gated dispatch (events accumulate between sweeps) must
+    still fire a follower's election timeout at its deadline: the gate is
+    bounded by the earliest armed deadline (_compute_next_sweep), not by
+    event arrival."""
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        slot = e.attach(rec)
+        s = e.state
+        cur = np.zeros(s.max_peers, bool)
+        cur[:3] = True
+        s.set_conf(slot, 0, cur, np.zeros(s.max_peers, bool),
+                   np.zeros(s.max_peers, np.int32), 0)
+        s.role[slot] = ROLE_FOLLOWER
+        s.mark_dirty(slot)
+        e.on_deadline(slot, 500)
+        await e.tick()  # dispatch: upload + arm
+        # quiet ticks before the deadline: gated (no dispatch, no timeout)
+        before = e.metrics["batched_dispatches"]
+        for t in (100, 200, 300):
+            e.clock.t = t
+            await e.tick()
+        assert e.metrics["batched_dispatches"] == before
+        assert "timeout" not in rec.events
+        # deadline passes: the next tick MUST dispatch and fire
+        e.clock.t = 501
+        await e.tick()
+        assert "timeout" in rec.events
+
+    asyncio.run(run())
+
+
+def test_sweep_gate_ships_backlog_before_staleness_check():
+    """Accumulated (gated) acks must reach the device BEFORE the staleness
+    sweep evaluates — a leader steadily receiving acks during the gated
+    window must not be declared stale at the next sweep."""
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        slot = _setup_leader(e, rec, n_peers=3, flush=5)
+        await e.tick()  # establish device state
+        # acks arrive during the gated window, device unaware until sweep
+        for t in range(50, 451, 50):
+            e.clock.t = t
+            e.on_ack(slot, 1, 5)
+            e.on_ack(slot, 2, 5)
+            await e.tick()
+        # leadership_timeout is 300ms; now=450 with fresh acks at 450:
+        # the sweep that finally dispatches must see them and NOT step down
+        e.clock.t = 460
+        await e.tick()
+        assert "stale" not in rec.events
+        # silence past the timeout -> stale fires at a later sweep
+        e.clock.t = 460 + 301
+        await e.tick()
+        e.clock.t = 460 + 602
+        await e.tick()
+        assert "stale" in rec.events
+
+    asyncio.run(run())

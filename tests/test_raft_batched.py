@@ -282,3 +282,45 @@ def test_heartbeat_coalescing_across_groups():
     props = batched_properties()
     props.set("raft.tpu.heartbeat.coalescing.enabled", "true")  # opt in
     run_batched(3, body, properties=props)
+
+
+def test_bulk_heartbeat_busy_skip_no_hol_blocking():
+    """A division whose append lock is held replies BULK_HB_BUSY without
+    stalling the rest of the envelope's items (head-of-line-blocking fix):
+    other divisions' items are served inline, and the busy division's
+    election deadline is safe because the lock-holding append resets it."""
+
+    async def body(cluster: MiniCluster):
+        from ratis_tpu.protocol.raftrpc import (BULK_HB_BUSY, BULK_HB_OK,
+                                                BulkHeartbeat)
+        await cluster.wait_for_leader()
+        # two groups on the same servers: add a sibling group
+        import uuid as _uuid
+
+        from ratis_tpu.protocol.group import RaftGroup
+        from ratis_tpu.protocol.ids import RaftGroupId
+        g2 = RaftGroup.value_of(RaftGroupId.random_id(),
+                                list(cluster.group.peers))
+        for s in cluster.servers.values():
+            await s.group_add(g2)
+        # pick a follower server and craft a 2-item bulk heartbeat from the
+        # leader of group 1 while group-2's append lock is HELD
+        leader = await cluster.wait_for_leader()
+        lid = leader.member_id.peer_id
+        follower_srv = next(s for s in cluster.servers.values()
+                            if s.peer_id != lid)
+        d1 = follower_srv.divisions[cluster.group.group_id]
+        d2 = follower_srv.divisions[g2.group_id]
+        async with d2._append_lock:  # simulate an in-flight slow append
+            items = (
+                (cluster.group.group_id.to_bytes(),
+                 d1.state.current_term, -1, -1),
+                (g2.group_id.to_bytes(), d2.state.current_term, -1, -1),
+            )
+            reply = await follower_srv._handle_bulk_heartbeat(
+                BulkHeartbeat(lid, follower_srv.peer_id, items))
+        codes = [item[0] for item in reply.items]
+        assert codes[0] == BULK_HB_OK, reply.items
+        assert codes[1] == BULK_HB_BUSY, reply.items
+
+    run_with_new_cluster(3, body)
