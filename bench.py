@@ -68,7 +68,10 @@ def child_e2e(spec: str) -> None:
                               warmup_writes=cfg.get("warmup", 1),
                               transport=cfg.get("transport", "sim"),
                               sm=cfg.get("sm", "counter"),
-                              num_servers=cfg.get("peers", 3))
+                              num_servers=cfg.get("peers", 3),
+                              hibernate=cfg.get("hibernate", False),
+                              active_groups=cfg.get("active"),
+                              settle_s=cfg.get("settle", 0.0))
         print("RESULT " + json.dumps(out))
 
     asyncio.run(main())
@@ -230,6 +233,17 @@ def main() -> None:
     grpc_s = _run_trials(json.dumps({
         "groups": 256, "writes": 8, "batched": False, "sm": "arithmetic",
         "concurrency": 128, "transport": "grpc"}), TRIALS)
+    # Sparse multi-tenant shape: 10240 hosted groups, 1024 actively
+    # written, the rest idle — idle-group hibernation (no reference
+    # analog; off in every other rung) vs the same shape without it.
+    sparse_hib = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 8, "batched": True,
+         "concurrency": 128, "warmup": 0, "active": 1024,
+         "hibernate": True, "settle": 20})], timeout_s=1800.0)
+    sparse_plain = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 8, "batched": True,
+         "concurrency": 128, "warmup": 0, "active": 1024,
+         "settle": 20})], timeout_s=1800.0)
     churn = _run_child(["--churn-child"], timeout_s=1200.0)
     mixed = _run_child(["--mixed-child"], timeout_s=1200.0)
     kernel = _run_child(["--kernel-child"])
@@ -276,6 +290,13 @@ def main() -> None:
             "sim_ladder_convergence_s": {
                 str(g): _median([t["election_convergence_s"] for t in r])
                 for g, r in sorted(ladder.items())},
+            "sparse_10240_active_1024": {
+                "hibernate_commits_per_sec": sparse_hib["commits_per_sec"],
+                "hibernate_p99_ms": sparse_hib["p99_ms"],
+                "hibernated_groups": sparse_hib.get("hibernated_groups", 0),
+                "plain_commits_per_sec": sparse_plain["commits_per_sec"],
+                "plain_p99_ms": sparse_plain["p99_ms"],
+            },
             "churn_1024": {
                 "commits_per_sec": churn["commits_per_sec"],
                 "p99_ms": churn["p99_ms"],

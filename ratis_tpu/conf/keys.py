@@ -359,6 +359,35 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY,
                 RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_DEFAULT)
 
+    class Hibernate:
+        """Idle-group quiescence (no reference analog; the multi-raft
+        production pattern TiKV calls hibernate regions): a leader whose
+        group has no pending work and fully-synced followers stops
+        heartbeating it, and its followers disarm their election timers —
+        an idle group costs ZERO background traffic.  Any contact (client
+        request, append, vote) wakes the group; the availability trade is
+        that a leader dying while hibernated is only detected at the next
+        contact.  Requires heartbeat coalescing (the hibernate handshake
+        rides the compact bulk items); OFF by default."""
+
+        ENABLED_KEY = "raft.tpu.hibernate.enabled"
+        ENABLED_DEFAULT = False
+        # quiet sweeps before a group hibernates
+        AFTER_SWEEPS_KEY = "raft.tpu.hibernate.after-sweeps"
+        AFTER_SWEEPS_DEFAULT = 4
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Hibernate.ENABLED_KEY,
+                RaftServerConfigKeys.Hibernate.ENABLED_DEFAULT)
+
+        @staticmethod
+        def after_sweeps(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Hibernate.AFTER_SWEEPS_KEY,
+                RaftServerConfigKeys.Hibernate.AFTER_SWEEPS_DEFAULT)
+
     class PauseMonitor:
         """Event-loop pause monitor (reference JvmPauseMonitor.java:38)."""
 

@@ -525,6 +525,8 @@ class QuorumEngine:
             elif kind == "timeout":
                 await listener.on_election_timeout()
             elif kind == "stale":
+                if getattr(listener, "hibernating", False):
+                    continue  # requested silence; cheap skip, no coroutine
                 await listener.on_leadership_stale()
 
     def _compute_next_sweep(self, now: int) -> int:

@@ -388,6 +388,11 @@ BULK_HB_UNKNOWN_GROUP = 2
 # election deadline, and the leader simply retries next sweep — so the
 # sweep never waits on a contended division (no head-of-line blocking).
 BULK_HB_BUSY = 3
+# Follower accepted a hibernate request (a normal bulk item with a 5th
+# flag field set): its election timer is DISARMED and the leader may stop
+# heartbeating the group (idle-group quiescence,
+# RaftServerConfigKeys.Hibernate).
+BULK_HB_HIBERNATED = 4
 
 
 @dataclasses.dataclass(frozen=True)

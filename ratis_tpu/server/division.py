@@ -155,6 +155,23 @@ class Division:
         self._append_lock = asyncio.Lock()
         self._slowness_timeout_s = \
             RaftServerConfigKeys.Rpc.slowness_timeout(p).seconds
+        # Idle-group quiescence (RaftServerConfigKeys.Hibernate; TiKV's
+        # hibernate-regions pattern): leader-side sleep bookkeeping.
+        self._hibernate_enabled = RaftServerConfigKeys.Hibernate.enabled(p)
+        self._hibernate_after = RaftServerConfigKeys.Hibernate.after_sweeps(p)
+        self._hibernating = False
+        self._quiet_sweeps = 0
+        # follower-side wake nudge: first client contact on a disarmed
+        # timer only RECORDS the moment (the client's retry to the still-
+        # alive leader wakes the group properly); a second contact after a
+        # full election timeout of continued leader silence re-arms
+        self._wake_nudge_s = 0.0
+        # staleness grace after wake: the silence was requested, so the
+        # leader must get a full leadership-timeout of resumed heartbeats
+        # before checkLeadership may judge it again
+        self._wake_grace_until = 0.0
+        self._election_timeout_min_s = \
+            RaftServerConfigKeys.Rpc.timeout_min(p).seconds
         self._slowness_notified: dict[RaftPeerId, float] = {}
         # Fire-and-forget notification tasks: the loop holds only weak refs,
         # so keep strong ones until completion or GC may drop them unrun.
@@ -310,6 +327,7 @@ class Division:
             my_peer.priority if my_peer is not None else 0)
 
     def reset_election_deadline(self) -> None:
+        self._wake_nudge_s = 0.0
         if self.engine_slot < 0 or self.is_listener():
             return
         engine = self.server.engine
@@ -505,6 +523,94 @@ class Division:
         self._spawn_bg(self.state_machine.notify_extended_no_leader(
             self.role_info()))
 
+    # ------------------------------------------------ idle-group hibernation
+
+    def _quiescent(self) -> bool:
+        """Nothing for this leader's group to say: no pending work and every
+        voting follower fully synced with nothing in flight."""
+        ctx = self.leader_ctx
+        if ctx is None or ctx.pending.requests() \
+                or self.watch_requests.pending_count() > 0:
+            return False
+        log = self.state.log
+        last = log.next_index - 1
+        if log.get_last_committed_index() != last:
+            return False
+        conf = self.state.configuration
+        for f in ctx.followers.values():
+            if not conf.contains_voting(f.peer_id):
+                continue
+            if f.match_index != last or f.snapshot_in_progress:
+                return False
+        return True
+
+    def hibernate_sweep(self, now: float) -> str:
+        """Called by the server heartbeat sweep per interval (leader +
+        coalescing only).  Returns:
+        - "awake":   heartbeat normally
+        - "request": heartbeat with the hibernate flag (ask followers to
+                     disarm their election timers)
+        - "asleep":  fully hibernated — contribute NO items this sweep
+        """
+        if not self._hibernate_enabled or not self.is_leader() \
+                or self.leader_ctx is None:
+            return "awake"
+        if self._hibernating:
+            return "asleep"
+        if not self._quiescent():
+            self._quiet_sweeps = 0
+            return "awake"
+        self._quiet_sweeps += 1
+        if self._quiet_sweeps < self._hibernate_after:
+            return "awake"
+        ctx = self.leader_ctx
+        conf = self.state.configuration
+        voting = [a for a in ctx.appenders.values()
+                  if conf.contains_voting(a.follower.peer_id)]
+        if voting and all(a.hibernate_acked for a in voting):
+            self._hibernating = True
+            LOG.info("%s hibernated (idle %d sweeps)", self.member_id,
+                     self._quiet_sweeps)
+            return "asleep"
+        return "request"
+
+    def wake_from_hibernation(self, reason: str = "") -> None:
+        """Any contact (client request, admin op, new entry) wakes the
+        group: resume heartbeats and refresh the staleness clock so the
+        leader is not instantly declared stale for the silence it was
+        ASKED to keep."""
+        if not self._hibernating and self._quiet_sweeps == 0:
+            return
+        was_asleep = self._hibernating
+        self._hibernating = False
+        self._quiet_sweeps = 0
+        # NO fabricated acks: last_ack_ms stays honest (a deposed leader
+        # must NOT regain a valid lease from its own wake; see
+        # _lease_valid) — the grace window alone suppresses the staleness
+        # verdict until resumed heartbeats have had a full timeout to
+        # produce REAL acks.
+        self._wake_grace_until = (
+            asyncio.get_running_loop().time()
+            + self.server.engine.leadership_timeout_ms / 1000.0)
+        if self.leader_ctx is not None:
+            import time as _time
+            now_s = _time.monotonic()
+            for a in self.leader_ctx.appenders.values():
+                a.hibernate_acked = False
+                a._last_send_s = 0.0  # next sweep heartbeats immediately
+                # slowness bookkeeping must not count the requested silence
+                a.follower.last_rpc_response_s = now_s
+        if was_asleep:
+            LOG.info("%s woke from hibernation (%s)", self.member_id,
+                     reason)
+
+    @property
+    def hibernating(self) -> bool:
+        """Engine-visible: suppress per-sweep stale dispatch while asleep
+        (the staleness output is level-triggered; a sleeping leader's
+        frozen acks would otherwise re-fire it every sweep)."""
+        return self._hibernating
+
     def on_commit_advance_now(self, new_commit: int) -> None:
         """Engine advanced this group's commit (leader only).  Synchronous
         on purpose: the engine calls this INLINE from the ack intake path
@@ -521,6 +627,12 @@ class Division:
         self.on_commit_advance_now(new_commit)
 
     async def on_leadership_stale(self) -> None:
+        if self._hibernating:
+            # silence was requested (followers' timers are disarmed too);
+            # staleness detection resumes at wake
+            return
+        if asyncio.get_running_loop().time() < self._wake_grace_until:
+            return  # just woke: give resumed heartbeats a full window
         if self.is_leader():
             await self.change_to_follower(
                 self.state.current_term, None,
@@ -616,6 +728,8 @@ class Division:
             if changed:
                 await self.state_machine.notify_leader_changed(
                     self.member_id, leader_id)
+        self._hibernating = False
+        self._quiet_sweeps = 0
         if old_role == RaftPeerRole.LEADER and self.leader_ctx is not None:
             self.message_stream_requests.clear()
             ctx = self.leader_ctx
@@ -762,7 +876,8 @@ class Division:
         return reply(AppendResult.SUCCESS, log.next_index)
 
     async def on_bulk_heartbeat(self, leader_id: RaftPeerId, term: int,
-                                leader_commit: int, commit_term: int
+                                leader_commit: int, commit_term: int,
+                                hibernate: bool = False
                                 ) -> tuple[int, int, int, int, int]:
         """One compact heartbeat item (protocol.raftrpc.BulkHeartbeat): the
         idle happy path of handle_append_entries without request building —
@@ -782,13 +897,16 @@ class Division:
         happy path this fast-path serves."""
         async with self._append_lock:
             return await self._on_bulk_heartbeat_locked(
-                leader_id, term, leader_commit, commit_term)
+                leader_id, term, leader_commit, commit_term, hibernate)
 
     async def _on_bulk_heartbeat_locked(self, leader_id: RaftPeerId,
                                         term: int, leader_commit: int,
-                                        commit_term: int
+                                        commit_term: int,
+                                        hibernate: bool = False
                                         ) -> tuple[int, int, int, int, int]:
-        from ratis_tpu.protocol.raftrpc import BULK_HB_NOT_LEADER, BULK_HB_OK
+        from ratis_tpu.protocol.raftrpc import (BULK_HB_HIBERNATED,
+                                                BULK_HB_NOT_LEADER,
+                                                BULK_HB_OK)
         state = self.state
         log = state.log
         if term < state.current_term:
@@ -806,6 +924,21 @@ class Division:
                 commit = min(leader_commit, log.flush_index)
                 if log.update_commit_index(commit, state.current_term, False):
                     self._apply_wake.set()
+        if hibernate:
+            # Idle-group quiescence: the leader asks to stop heartbeating.
+            # Accept (DISARM the election timer) only when fully synced with
+            # the leader's commit frontier — the item carries real commit
+            # info, so a lagging follower catches up right here and accepts
+            # on a later sweep; otherwise the armed timer makes the leader
+            # keep heartbeating.
+            if log.get_last_committed_index() >= leader_commit \
+                    and log.flush_index >= leader_commit \
+                    and self.engine_slot >= 0:
+                from ratis_tpu.engine.state import NO_DEADLINE
+                self.server.engine.on_deadline(self.engine_slot, NO_DEADLINE)
+                return (BULK_HB_HIBERNATED, state.current_term,
+                        log.next_index, log.get_last_committed_index(),
+                        log.flush_index)
         return (BULK_HB_OK, state.current_term, log.next_index,
                 log.get_last_committed_index(), log.flush_index)
 
@@ -1228,6 +1361,28 @@ class Division:
 
     async def submit_client_request(self, req: RaftClientRequest) -> RaftClientReply:
         self.metrics.num_requests.inc()
+        if self._hibernating or self._quiet_sweeps:
+            self.wake_from_hibernation("client request")
+        elif not self.is_leader() and self.engine_slot >= 0:
+            # A hibernated group's follower contacted by a client: if the
+            # leader is alive, the client's retry TO the leader wakes the
+            # group (heartbeats resume and re-arm us), so the FIRST contact
+            # only records a nudge.  Only a second contact after a full
+            # election timeout of continued silence re-arms the timer —
+            # that is the dead-leader case, and the group must become
+            # electable again.  Re-arming eagerly would let every client
+            # probe of a healthy sleeping group trigger an election.
+            from ratis_tpu.engine.state import NO_DEADLINE as _ND
+            eng = self.server.engine
+            if int(eng.state.election_deadline_ms[self.engine_slot]) == _ND \
+                    and self.is_follower():
+                now = asyncio.get_running_loop().time()
+                if self._wake_nudge_s and (now - self._wake_nudge_s
+                                           > self._election_timeout_min_s):
+                    self._wake_nudge_s = 0.0
+                    self.reset_election_deadline()
+                elif not self._wake_nudge_s:
+                    self._wake_nudge_s = now
         if req.replied_call_ids:
             # piggybacked retry-cache GC (RaftClientImpl.RepliedCallIds)
             self.retry_cache.evict_replied(req.client_id.to_bytes(),

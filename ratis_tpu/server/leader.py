@@ -155,6 +155,9 @@ class LogAppender:
         self._last_error_log_s = 0.0
         self._prefaulting = False
         self._ci_countdown = 0  # commit-infos piggyback thinning
+        # follower accepted a hibernate request (division.hibernate_sweep);
+        # cleared on wake / any send / window reset
+        self.hibernate_acked = False
         self._pending_sends: set[asyncio.Task] = set()
 
     def start(self) -> None:
@@ -244,6 +247,7 @@ class LogAppender:
         request map)."""
         self._epoch += 1
         self._inflight = 0
+        self.hibernate_acked = False  # the follower's timer may be re-armed
         f = self.follower
         # NB: the rewind target is deliberately NOT floored at log.start_index
         # — next_index < start_index is exactly what routes collect() into
@@ -337,6 +341,11 @@ class LogAppender:
         finally:
             if not added:
                 self._busy = False
+            else:
+                # any send re-arms the follower's election timer: a stale
+                # hibernate ack must not let the leader fall asleep without
+                # a fresh handshake
+                self.hibernate_acked = False
         return added
 
     def envelope_done(self, remark: bool = True) -> None:
@@ -407,11 +416,14 @@ class LogAppender:
         await self._on_reply(request, reply, epoch)
         self.notify()
 
-    def heartbeat_item(self, now: float) -> Optional[tuple]:
+    def heartbeat_item(self, now: float,
+                       hibernate: bool = False) -> Optional[tuple]:
         """Contribute this follower's compact item to the sweep's
         BulkHeartbeat toward its destination server, or None when not due
         (recent traffic doubles as a heartbeat, exactly like the unary
-        path).  Also doubles as the periodic fill-retry waker."""
+        path).  Also doubles as the periodic fill-retry waker.  With
+        ``hibernate`` the item carries the hibernate flag, asking the follower
+        to disarm its election timer (idle-group quiescence)."""
         div = self.division
         if not self._running or not div.is_leader():
             return None
@@ -435,10 +447,14 @@ class LogAppender:
             return None
         log = div.state.log
         commit = log.get_last_committed_index()
-        cti = log.get_term_index(commit) if commit >= 0 else None
         self._last_send_s = now
-        return (div.group_id.to_bytes(), div.state.current_term, commit,
+        cti = log.get_term_index(commit) if commit >= 0 else None
+        base = (div.group_id.to_bytes(), div.state.current_term, commit,
                 cti.term if cti is not None else -1)
+        # hibernate request rides as a 5th flag field so the item still
+        # carries real commit info (a lagging follower must be able to
+        # catch its commit up from these very items to pass the sync gate)
+        return base + (1,) if hibernate else base
 
     async def on_bulk_reply(self, code: int, term: int, next_index: int,
                             follower_commit: int, flush_index: int) -> None:
@@ -446,7 +462,8 @@ class LogAppender:
         the follower fresh (staleness + watch frontiers); any anomaly
         escalates to a full AppendEntries probe on the data path, which
         carries the prev check the compact item omits."""
-        from ratis_tpu.protocol.raftrpc import (BULK_HB_OK,
+        from ratis_tpu.protocol.raftrpc import (BULK_HB_HIBERNATED,
+                                                BULK_HB_OK,
                                                 BULK_HB_UNKNOWN_GROUP)
         div = self.division
         if not self._running or not div.is_leader():
@@ -457,6 +474,14 @@ class LogAppender:
             await div.change_to_follower(
                 term, None, reason="higher term in bulk heartbeat reply")
             return
+        if code == BULK_HB_HIBERNATED:
+            # follower disarmed its election timer: this channel may sleep
+            self.hibernate_acked = True
+            f = self.follower
+            f.last_rpc_response_s = time.monotonic()
+            div.on_follower_heartbeat_ack(f)
+            return
+        self.hibernate_acked = False  # any other reply: timer is armed
         if code != BULK_HB_OK:
             # stale NOT_LEADER at <= our term, or BUSY (the item was skipped
             # because our own in-flight append holds the division's lock —

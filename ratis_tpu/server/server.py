@@ -106,10 +106,15 @@ class HeartbeatScheduler:
                         # urgency is seconds, so a quarter-rate phase-spread
                         # scan keeps the sweep cheap at thousands of leaders
                         div.check_yield_to_higher_priority()
+                    hib = (div.hibernate_sweep(now) if coalesce
+                           else "awake")
+                    if hib == "asleep":
+                        continue  # hibernated: the group costs nothing
                     for appender in list(div.leader_ctx.appenders.values()):
                         sweep += 1
                         if coalesce:
-                            item = appender.heartbeat_item(now)
+                            item = appender.heartbeat_item(
+                                now, hibernate=(hib == "request"))
                             if item is not None:
                                 b = bulk.setdefault(
                                     appender.follower.peer_id, ([], []))
@@ -508,7 +513,9 @@ class RaftServer:
         miss = (BULK_HB_UNKNOWN_GROUP, -1, -1, -1, -1)
         busy = (BULK_HB_BUSY, -1, -1, -1, -1)
         results: list = [miss] * len(items)
-        for n, (gid_bytes, term, commit, commit_term) in enumerate(items):
+        for n, item in enumerate(items):
+            gid_bytes, term, commit, commit_term = item[:4]
+            hibernate = len(item) > 4 and bool(item[4])
             div = self.divisions.get(RaftGroupId.value_of(gid_bytes))
             if div is None:
                 pass  # results[n] stays UNKNOWN_GROUP
@@ -517,7 +524,8 @@ class RaftServer:
             else:
                 try:
                     results[n] = await div.on_bulk_heartbeat(
-                        src, term, commit, commit_term)
+                        src, term, commit, commit_term,
+                        hibernate=hibernate)
                 except Exception:
                     LOG.exception("%s bulk heartbeat item failed",
                                   self.peer_id)

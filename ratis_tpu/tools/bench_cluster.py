@@ -44,7 +44,8 @@ from ratis_tpu.transport.simulated import (SimulatedNetwork,
                                            SimulatedTransportFactory)
 
 
-def bench_properties(batched: bool, num_groups: int = 1) -> RaftProperties:
+def bench_properties(batched: bool, num_groups: int = 1,
+                     hibernate: bool = False) -> RaftProperties:
     from ratis_tpu.engine.engine import QuorumEngine
     p = RaftProperties()
     # Timeouts scale with group density: background heartbeat volume is
@@ -88,6 +89,10 @@ def bench_properties(batched: bool, num_groups: int = 1) -> RaftProperties:
         p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
         p.set(RaftServerConfigKeys.Log.Appender.COALESCING_ENABLED_KEY, "true")
         p.set(RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY, "true")
+        if hibernate:
+            # idle-group quiescence (requires the coalesced heartbeat
+            # channel): idle groups cost zero background traffic
+            p.set(RaftServerConfigKeys.Hibernate.ENABLED_KEY, "true")
     else:
         # the reference's cost shape: one Python pass per group per event
         # (thread-per-division EventProcessor analog) and one RPC per
@@ -104,12 +109,14 @@ class BenchCluster:
 
     def __init__(self, num_groups: int, num_servers: int = 3,
                  batched: bool = True, transport: str = "sim",
-                 sm: str = "counter", datastream: bool = False):
+                 sm: str = "counter", datastream: bool = False,
+                 hibernate: bool = False):
         self.num_groups = num_groups
         self.batched = batched
         self.transport = transport
         self.sm = sm
         self.datastream = datastream
+        self.hibernate = hibernate
         if transport in ("tcp", "grpc"):
             # Real localhost sockets: every RPC pays framing + syscalls, so
             # the per-(group,follower) stream shape costs what it costs the
@@ -140,7 +147,8 @@ class BenchCluster:
                      for i in range(num_servers)]
         else:
             raise ValueError(f"unknown bench transport {transport!r}")
-        self.properties = bench_properties(batched, num_groups)
+        self.properties = bench_properties(batched, num_groups,
+                                           hibernate=hibernate)
         self.groups = [RaftGroup.value_of(RaftGroupId.random_id(), peers)
                        for _ in range(num_groups)]
         if sm == "filestore":
@@ -286,14 +294,19 @@ class BenchCluster:
 
     async def run_load(self, writes_per_group: int,
                        concurrency: int = 256,
-                       message_factory=None) -> dict:
+                       message_factory=None,
+                       active_groups: Optional[int] = None) -> dict:
         """Drive writes_per_group sequential writes per group, groups
         concurrent under a global in-flight bound; returns throughput and
         latency percentiles.  ``message_factory`` builds per-write payloads
-        (default: the counter INCREMENT)."""
+        (default: the counter INCREMENT).  ``active_groups`` restricts the
+        load to the first N groups — the sparse multi-tenant shape where
+        most hosted groups are cold."""
         client = self.factory.new_client_transport()
         sem = asyncio.Semaphore(concurrency)
         latencies: list[float] = []
+        target_groups = (self.groups if active_groups is None
+                         else self.groups[:active_groups])
 
         async def group_load(g: RaftGroup):
             client_id = ClientId.random_id()
@@ -307,12 +320,12 @@ class BenchCluster:
                     latencies.append(time.monotonic() - t0)
 
         t_start = time.monotonic()
-        await asyncio.gather(*(group_load(g) for g in self.groups))
+        await asyncio.gather(*(group_load(g) for g in target_groups))
         elapsed = time.monotonic() - t_start
 
         latencies.sort()
         n = len(latencies)
-        total = self.num_groups * writes_per_group
+        total = len(target_groups) * writes_per_group
         return {
             "commits": total,
             "elapsed_s": round(elapsed, 3),
@@ -329,7 +342,8 @@ class BenchCluster:
 @contextlib.asynccontextmanager
 async def _started_cluster(num_groups: int, batched: bool,
                            transport: str = "sim", sm: str = "counter",
-                           datastream: bool = False, num_servers: int = 3):
+                           datastream: bool = False, num_servers: int = 3,
+                           hibernate: bool = False):
     """Shared rung scaffold: build + start the cluster with the GC tuning
     every rung needs (defer gen-2 cascades during bring-up, then freeze the
     post-bring-up heap out of the collector — a single gen-2 pass over the
@@ -338,7 +352,8 @@ async def _started_cluster(num_groups: int, batched: bool,
     gc.set_threshold(700, 1000, 1000)
     cluster = BenchCluster(num_groups, num_servers=num_servers,
                            batched=batched, transport=transport,
-                           sm=sm, datastream=datastream)
+                           sm=sm, datastream=datastream,
+                           hibernate=hibernate)
     try:
         await cluster.start()
         gc.collect()
@@ -351,11 +366,17 @@ async def _started_cluster(num_groups: int, batched: bool,
 async def run_bench(num_groups: int, writes_per_group: int,
                     batched: bool = True, concurrency: int = 256,
                     warmup_writes: int = 1, transport: str = "sim",
-                    sm: str = "counter", num_servers: int = 3) -> dict:
+                    sm: str = "counter", num_servers: int = 3,
+                    hibernate: bool = False, active_groups=None,
+                    settle_s: float = 0.0) -> dict:
     """One ladder rung: build the ``num_servers``-server cluster, elect,
     warm up, measure, tear down."""
     async with _started_cluster(num_groups, batched, transport=transport,
-                                sm=sm, num_servers=num_servers) as cluster:
+                                sm=sm, num_servers=num_servers,
+                                hibernate=hibernate) as cluster:
+        if hibernate and settle_s:
+            # let idle groups actually fall asleep before measuring
+            await asyncio.sleep(settle_s)
         mf = None
         if sm == "arithmetic":
             # BASELINE config 2's workload shape: var = expression writes
@@ -364,9 +385,11 @@ async def run_bench(num_groups: int, writes_per_group: int,
             mf = lambda: f"v{next(seq) % 7}={next(seq) % 97}+1".encode()
         if warmup_writes:
             await cluster.run_load(warmup_writes, concurrency,
-                                   message_factory=mf)
+                                   message_factory=mf,
+                                   active_groups=active_groups)
         result = await cluster.run_load(writes_per_group, concurrency,
-                                        message_factory=mf)
+                                        message_factory=mf,
+                                        active_groups=active_groups)
         engines = [s.engine for s in cluster.servers]
         result["batched_dispatches"] = sum(
             e.metrics["batched_dispatches"] for e in engines)
@@ -375,6 +398,13 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport
         result["peers"] = num_servers
+        if active_groups is not None:
+            result["active_groups"] = active_groups
+        if hibernate:
+            result["hibernate"] = True
+            result["hibernated_groups"] = sum(
+                1 for s2 in cluster.servers
+                for d in s2.divisions.values() if d._hibernating)
         return result
 
 

@@ -1,0 +1,108 @@
+"""Idle-group hibernation (RaftServerConfigKeys.Hibernate; the TiKV
+hibernate-regions pattern, no reference analog): an idle group's leader
+stops heartbeating and its followers disarm election timers — zero
+background traffic — with wake-on-contact semantics."""
+
+import asyncio
+
+import numpy as np
+
+import pytest
+
+from minicluster import MiniCluster, batched_properties, run_with_new_cluster
+from ratis_tpu.conf.keys import RaftServerConfigKeys
+from ratis_tpu.engine.state import NO_DEADLINE
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _prewarm_kernels():
+    # compile the batched kernels once up front: a cold jit stall mid-test
+    # is long enough to distort the hibernation timing being asserted
+    from ratis_tpu.engine.engine import QuorumEngine
+    QuorumEngine(max_groups=1024, max_peers=8).prewarm(
+        group_counts=(64,), event_counts=(64,))
+
+
+def _hibernate_properties():
+    p = batched_properties()
+    p.set(RaftServerConfigKeys.Hibernate.ENABLED_KEY, "true")
+    p.set(RaftServerConfigKeys.Hibernate.AFTER_SWEEPS_KEY, "2")
+    return p
+
+
+async def _wait_hibernated(cluster, timeout=20.0):
+    await cluster.wait_for_leader()
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        # leadership may move while settling; find WHOEVER hibernated
+        for d in cluster.divisions():
+            if d._hibernating:
+                return d
+        await asyncio.sleep(0.05)
+    raise TimeoutError("group never hibernated")
+
+
+def test_idle_group_hibernates_and_wakes_on_write():
+    async def body(cluster: MiniCluster):
+        assert (await cluster.send_write()).success
+        leader = await _wait_hibernated(cluster)
+        # followers' election timers are disarmed
+        for d in cluster.divisions():
+            if d is leader:
+                continue
+            eng = cluster.servers[d.member_id.peer_id].engine
+            assert int(eng.state.election_deadline_ms[d.engine_slot]) \
+                == NO_DEADLINE
+        # heartbeat traffic STOPS: bulk item counts freeze
+        before = sum(s.heartbeats.metrics["heartbeats"]
+                     for s in cluster.servers.values())
+        await asyncio.sleep(0.5)  # several sweep intervals
+        after = sum(s.heartbeats.metrics["heartbeats"]
+                    for s in cluster.servers.values())
+        assert after == before, "hibernated group still heartbeating"
+        # a write wakes the group and commits normally
+        reply = await cluster.send_write()
+        assert reply.success
+        assert not leader._hibernating
+        # ...and it re-hibernates once idle again
+        await _wait_hibernated(cluster)
+
+    run_with_new_cluster(3, body, properties=_hibernate_properties())
+
+
+def test_hibernated_leader_not_stepped_down_as_stale():
+    """A hibernated leader hears no acks by design; the staleness sweep
+    must not abdicate it while asleep, and it serves writes at wake."""
+
+    async def body(cluster: MiniCluster):
+        assert (await cluster.send_write()).success
+        leader = await _wait_hibernated(cluster)
+        # sleep well past the leadership-staleness window
+        timeout_s = leader.server.engine.leadership_timeout_ms / 1000.0
+        await asyncio.sleep(min(timeout_s * 2, 3.0))
+        assert leader.is_leader(), "hibernated leader was stepped down"
+        assert (await cluster.send_write()).success
+
+    run_with_new_cluster(3, body, properties=_hibernate_properties())
+
+
+def test_dead_hibernated_leader_recovers_on_client_contact():
+    """Leader dies while the group sleeps: the group stays quiet (the
+    accepted availability trade) until ANY client contact wakes a
+    follower, which re-arms its timer, elects, and serves the write."""
+
+    async def body(cluster: MiniCluster):
+        assert (await cluster.send_write()).success
+        leader = await _wait_hibernated(cluster)
+        lid = leader.member_id.peer_id
+        await cluster.kill_server(lid)
+        # the survivors are disarmed: give them time to NOT elect
+        await asyncio.sleep(0.8)
+        assert not any(d.is_leader() for d in cluster.divisions()), \
+            "disarmed followers elected without being woken"
+        # first client contact wakes a follower -> election -> write lands
+        reply = await cluster.send_write()
+        assert reply.success
+        assert any(d.is_leader() for d in cluster.divisions())
+
+    run_with_new_cluster(3, body, properties=_hibernate_properties())
