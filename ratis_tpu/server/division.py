@@ -238,6 +238,9 @@ class Division:
         return self._rng.uniform(self._timeout_min_s, self._timeout_max_s)
 
     def get_leader_peer(self) -> Optional[RaftPeer]:
+        # NB: a non-leader's hint can never name SELF — abdication without
+        # a successor clears leader_id in change_to_follower (a stale
+        # self-suggestion pins retrying clients in a self-referral loop).
         lid = self.state.leader_id
         if lid is None:
             return None
@@ -730,6 +733,14 @@ class Division:
                     self.member_id, leader_id)
         self._hibernating = False
         self._quiet_sweeps = 0
+        if old_role == RaftPeerRole.LEADER and leader_id is None:
+            # Abdication without a known successor: the stale hint still
+            # names SELF, and every leader_id consumer (NotLeader
+            # suggestions, readIndex forwarding, GroupInfo) would keep
+            # reporting this non-leader as the leader — clients retrying
+            # the suggestion would loop on this node forever.  We genuinely
+            # don't know the leader: clear it.
+            self.state.set_leader(None)
         if old_role == RaftPeerRole.LEADER and self.leader_ctx is not None:
             self.message_stream_requests.clear()
             ctx = self.leader_ctx

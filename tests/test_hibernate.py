@@ -106,3 +106,41 @@ def test_dead_hibernated_leader_recovers_on_client_contact():
         assert any(d.is_leader() for d in cluster.divisions())
 
     run_with_new_cluster(3, body, properties=_hibernate_properties())
+
+
+def test_hibernated_group_partition_safety():
+    """Partition a hibernated leader away, then write: the woken leader
+    cannot replicate, steps down after its wake grace, and the client's
+    retries wake a follower into an election — exactly one committed
+    value per write, no divergence after heal."""
+
+    async def body(cluster: MiniCluster):
+        assert (await cluster.send_write()).success
+        leader = await _wait_hibernated(cluster)
+        lid = leader.member_id.peer_id
+        others = [d.member_id.peer_id for d in cluster.divisions()
+                  if d.member_id.peer_id != lid]
+        cluster.network.partition([lid], others)
+        # write while partitioned: must eventually land on the majority
+        # side (the isolated leader wakes, fails to replicate, abdicates).
+        # Generous budget: the first attempt sinks ~3s pending at the
+        # isolated leader before the client moves on and nudges a
+        # follower awake.
+        reply = await cluster.send(b"INCREMENT", timeout=30.0)
+        assert reply.success
+        cluster.network.unblock_all()
+        # heal: the old leader rejoins as follower and converges
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            counters = {d.member_id.peer_id: d.state_machine.counter
+                        for d in cluster.divisions()}
+            if len(set(counters.values())) == 1 \
+                    and next(iter(counters.values())) == 2:
+                break
+            await asyncio.sleep(0.05)
+        counters = {str(d.member_id.peer_id): d.state_machine.counter
+                    for d in cluster.divisions()}
+        assert set(counters.values()) == {2}, counters
+        assert (await cluster.send_write()).success
+
+    run_with_new_cluster(3, body, properties=_hibernate_properties())
