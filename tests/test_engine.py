@@ -534,3 +534,26 @@ def test_sweep_gate_ships_backlog_before_staleness_check():
         assert "stale" in rec.events
 
     asyncio.run(run())
+
+
+def test_vote_round_expires_early_when_all_replied():
+    """expire_vote_round (all peers replied or failed) resolves the round
+    at the NEXT tick via the timeout-path tally instead of waiting out
+    the full round deadline — the outstanding==0 early exit of the
+    reference's waitForResults."""
+    async def run():
+        e = _mk_engine(use_device=True)
+        rec = Recorder()
+        # higher-priority peer 1 never replies (its RPC failed); peer 2
+        # grants -> majority, but the strict pass is gated on peer 1
+        slot = _setup_candidate(e, rec, priorities=[0, 5, 0])
+        fut = e.begin_vote_round(slot, deadline_ms=60_000)
+        e.on_vote_reply(slot, 2, granted=True)
+        await e.tick()
+        assert not fut.done()  # gated on the silent higher-priority peer
+        e.expire_vote_round(slot)  # all RPCs concluded
+        e.clock.t += 1
+        await e.tick()
+        assert fut.done() and fut.result() == "PASSED"
+
+    asyncio.run(run())
