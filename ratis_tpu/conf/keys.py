@@ -375,6 +375,14 @@ class RaftServerConfigKeys:
         # quiet sweeps before a group hibernates
         AFTER_SWEEPS_KEY = "raft.tpu.hibernate.after-sweeps"
         AFTER_SWEEPS_DEFAULT = 4
+        # Dead-leader backstop: a hibernated follower arms this (long)
+        # election deadline instead of disarming outright, and the sleeping
+        # leader sends ONE hibernate-flagged heartbeat per backstop/4 to
+        # keep refreshing it.  A dead leader stops refreshing, so the group
+        # becomes electable again within ~backstop even with zero client
+        # traffic.  "0s" restores the round-4 full-disarm behavior.
+        BACKSTOP_KEY = "raft.tpu.hibernate.backstop"
+        BACKSTOP_DEFAULT = "60s"
 
         @staticmethod
         def enabled(p: RaftProperties) -> bool:
@@ -387,6 +395,12 @@ class RaftServerConfigKeys:
             return p.get_int(
                 RaftServerConfigKeys.Hibernate.AFTER_SWEEPS_KEY,
                 RaftServerConfigKeys.Hibernate.AFTER_SWEEPS_DEFAULT)
+
+        @staticmethod
+        def backstop(p: RaftProperties):
+            return p.get_time_duration(
+                RaftServerConfigKeys.Hibernate.BACKSTOP_KEY,
+                RaftServerConfigKeys.Hibernate.BACKSTOP_DEFAULT)
 
     class PauseMonitor:
         """Event-loop pause monitor (reference JvmPauseMonitor.java:38)."""
@@ -415,6 +429,32 @@ class RaftServerConfigKeys:
             return p.get_time_duration(
                 RaftServerConfigKeys.PauseMonitor.WARN_KEY,
                 RaftServerConfigKeys.PauseMonitor.WARN_DEFAULT)
+
+    class Gc:
+        """Heap discipline for multi-raft hosts (ratis_tpu.util.gcdiscipline;
+        no reference analog — CPython's gen-2 collector over a 10k-group
+        heap measured a 52s pause, enough for the pause monitor to depose
+        every leader on the server).  Opt-in: tunes GC thresholds at
+        server start and, once the group set has been idle for
+        ``freeze-idle``, runs one deliberate full collection and freezes
+        the surviving heap out of the collector."""
+
+        DISCIPLINE_KEY = "raft.tpu.gc.discipline"
+        DISCIPLINE_DEFAULT = False
+        FREEZE_IDLE_KEY = "raft.tpu.gc.freeze-idle"
+        FREEZE_IDLE_DEFAULT = TimeDuration.valueOf("10s")
+
+        @staticmethod
+        def discipline(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Gc.DISCIPLINE_KEY,
+                RaftServerConfigKeys.Gc.DISCIPLINE_DEFAULT)
+
+        @staticmethod
+        def freeze_idle(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Gc.FREEZE_IDLE_KEY,
+                RaftServerConfigKeys.Gc.FREEZE_IDLE_DEFAULT)
 
     class Notification:
         NO_LEADER_TIMEOUT_KEY = "raft.server.notification.no-leader.timeout"

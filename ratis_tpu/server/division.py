@@ -159,8 +159,17 @@ class Division:
         # hibernate-regions pattern): leader-side sleep bookkeeping.
         self._hibernate_enabled = RaftServerConfigKeys.Hibernate.enabled(p)
         self._hibernate_after = RaftServerConfigKeys.Hibernate.after_sweeps(p)
+        self._hibernate_backstop_s = \
+            RaftServerConfigKeys.Hibernate.backstop(p).seconds
         self._hibernating = False
         self._quiet_sweeps = 0
+        # leader side: monotonic time of the last slow-tick heartbeat sent
+        # while asleep (refreshes follower backstop deadlines)
+        self._last_hib_slow_tick = 0.0
+        # follower side: the armed election deadline is the hibernate
+        # BACKSTOP (long), not a normal timeout — client-contact nudges key
+        # off this, and any real leader contact clears it
+        self._hibernated_follower = False
         # follower-side wake nudge: first client contact on a disarmed
         # timer only RECORDS the moment (the client's retry to the still-
         # alive leader wakes the group properly); a second contact after a
@@ -331,6 +340,7 @@ class Division:
 
     def reset_election_deadline(self) -> None:
         self._wake_nudge_s = 0.0
+        self._hibernated_follower = False
         if self.engine_slot < 0 or self.is_listener():
             return
         engine = self.server.engine
@@ -559,6 +569,15 @@ class Division:
                 or self.leader_ctx is None:
             return "awake"
         if self._hibernating:
+            # Dead-leader backstop slow tick: one hibernate-flagged
+            # heartbeat per backstop/4 refreshes the followers' (long)
+            # backstop deadlines; if this leader dies, the refreshes stop
+            # and the group becomes electable again within ~backstop.
+            if self._hibernate_backstop_s > 0 and \
+                    now - self._last_hib_slow_tick \
+                    >= self._hibernate_backstop_s / 4:
+                self._last_hib_slow_tick = now
+                return "request"
             return "asleep"
         if not self._quiescent():
             self._quiet_sweeps = 0
@@ -570,8 +589,13 @@ class Division:
         conf = self.state.configuration
         voting = [a for a in ctx.appenders.values()
                   if conf.contains_voting(a.follower.peer_id)]
-        if voting and all(a.hibernate_acked for a in voting):
+        # An empty voting-appender set (all remaining followers are
+        # listeners) is trivially acked — parking in "request" forever
+        # would hibernate-flag non-voting followers every sweep with no
+        # path to "asleep".
+        if all(a.hibernate_acked for a in voting):
             self._hibernating = True
+            self._last_hib_slow_tick = now
             LOG.info("%s hibernated (idle %d sweeps)", self.member_id,
                      self._quiet_sweeps)
             return "asleep"
@@ -937,16 +961,31 @@ class Division:
                     self._apply_wake.set()
         if hibernate:
             # Idle-group quiescence: the leader asks to stop heartbeating.
-            # Accept (DISARM the election timer) only when fully synced with
-            # the leader's commit frontier — the item carries real commit
-            # info, so a lagging follower catches up right here and accepts
-            # on a later sweep; otherwise the armed timer makes the leader
-            # keep heartbeating.
+            # Accept only when fully synced with the leader's commit
+            # frontier — the item carries real commit info, so a lagging
+            # follower catches up right here and accepts on a later sweep;
+            # otherwise the armed timer makes the leader keep heartbeating.
+            # Accepting arms the long BACKSTOP deadline (not a full disarm):
+            # the sleeping leader's slow tick keeps refreshing it, so a dead
+            # leader is detected within ~backstop even with zero client
+            # traffic (backstop=0 restores the full disarm).
             if log.get_last_committed_index() >= leader_commit \
                     and log.flush_index >= leader_commit \
                     and self.engine_slot >= 0:
                 from ratis_tpu.engine.state import NO_DEADLINE
-                self.server.engine.on_deadline(self.engine_slot, NO_DEADLINE)
+                if self._hibernate_backstop_s > 0:
+                    # clamp: the engine's deadline array is int32 ms, and a
+                    # "30d" backstop must degrade to the sentinel (full
+                    # disarm), not overflow the store
+                    deadline = min(
+                        self.server.engine.clock.now_ms() + int(
+                            (self._hibernate_backstop_s
+                             + self.random_election_timeout_s()) * 1000),
+                        NO_DEADLINE)
+                else:
+                    deadline = NO_DEADLINE
+                self.server.engine.on_deadline(self.engine_slot, deadline)
+                self._hibernated_follower = True
                 return (BULK_HB_HIBERNATED, state.current_term,
                         log.next_index, log.get_last_committed_index(),
                         log.flush_index)
@@ -1383,10 +1422,7 @@ class Division:
             # that is the dead-leader case, and the group must become
             # electable again.  Re-arming eagerly would let every client
             # probe of a healthy sleeping group trigger an election.
-            from ratis_tpu.engine.state import NO_DEADLINE as _ND
-            eng = self.server.engine
-            if int(eng.state.election_deadline_ms[self.engine_slot]) == _ND \
-                    and self.is_follower():
+            if self._hibernated_follower and self.is_follower():
                 now = asyncio.get_running_loop().time()
                 if self._wake_nudge_s and (now - self._wake_nudge_s
                                            > self._election_timeout_min_s):

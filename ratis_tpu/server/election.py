@@ -279,7 +279,12 @@ class LeaderElection:
         try:
             result_str = await fut
         except asyncio.CancelledError:
-            if not fut.cancelled():
+            # Only a deliberate round abandonment (a special reply recorded
+            # in ``special`` or stop()) may swallow the cancellation.  The
+            # round future being cancelled is NOT proof of that: an external
+            # task cancellation can land in the same instant, and proceeding
+            # (possibly into change_to_follower) would ignore it.
+            if not fut.cancelled() or (not special and not self._stopped):
                 raise  # the election task itself was cancelled
             # round abandoned (special reply / stop / step-down)
             result, new_term = special.get("result",

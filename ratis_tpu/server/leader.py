@@ -440,7 +440,12 @@ class LogAppender:
             self.sender.mark(self)
         elif self._probe_due or div.state.log.next_index > f.next_index:
             self.sender.mark(self)
-        div.check_follower_slowness(f)
+        if not div.hibernating:
+            # while asleep the ONLY traffic is the backstop slow tick, so
+            # ack clocks are legitimately backstop/4 old — judging that as
+            # follower slowness would spam notifications for silence the
+            # leader itself requested
+            div.check_follower_slowness(f)
         if now - self._last_send_s < self.heartbeat_interval_s * 0.9:
             return None
         if now < self._backoff_until or f.snapshot_in_progress:

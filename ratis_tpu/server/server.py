@@ -271,6 +271,8 @@ class RaftServer:
         # group_add after startup.
         self.datastream = None
         self._datastream_started = False
+        self._gc_disciplined = False
+        self._gc_task: Optional[asyncio.Task] = None
         if group is not None:
             self._maybe_create_datastream(group)
 
@@ -292,6 +294,16 @@ class RaftServer:
         self.life_cycle.transition(LifeCycleState.STARTING)
         await self.engine.start()
         from ratis_tpu.conf.keys import RaftServerConfigKeys as _K
+        if _K.Gc.discipline(self.properties):
+            # Heap discipline (util.gcdiscipline): tuned thresholds now, one
+            # deliberate collect+freeze once the group set settles — instead
+            # of the collector's own 52s-at-10k-groups pause mid-consensus.
+            from ratis_tpu.util import gcdiscipline
+            gcdiscipline.enable()
+            self._gc_disciplined = True
+            self._gc_task = asyncio.create_task(
+                self._gc_janitor(_K.Gc.freeze_idle(self.properties).seconds),
+                name=f"gc-janitor-{self.peer_id}")
         if _K.PauseMonitor.enabled(self.properties):
             from ratis_tpu.server.pause_monitor import PauseMonitor
             self.pause_monitor = PauseMonitor(self)
@@ -334,6 +346,17 @@ class RaftServer:
         if self.pause_monitor is not None:
             await self.pause_monitor.close()
             self.pause_monitor = None
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            try:
+                await self._gc_task
+            except asyncio.CancelledError:
+                pass
+            self._gc_task = None
+        if self._gc_disciplined:
+            from ratis_tpu.util import gcdiscipline
+            gcdiscipline.disable()
+            self._gc_disciplined = False
         await self.heartbeat_scheduler.close()
         await self.transport.close()
         if self.datastream is not None:
@@ -355,6 +378,32 @@ class RaftServer:
         await self.replication.close()
         await self.engine.close()
         self.life_cycle.transition(LifeCycleState.CLOSED)
+
+    async def _gc_janitor(self, freeze_idle_s: float) -> None:
+        """Waits for the group set to settle, then seals the heap (ONE
+        deliberate collect+freeze) so the collector never walks the
+        division fleet again; re-seals after later add/remove bursts."""
+        if freeze_idle_s <= 0:
+            return
+        from ratis_tpu.util import gcdiscipline
+        poll = max(min(freeze_idle_s / 2, 5.0), 0.05)
+        while True:
+            await asyncio.sleep(poll)
+            if gcdiscipline.seal_due(freeze_idle_s):
+                # inline on purpose: gc.collect holds the GIL throughout, so
+                # a worker thread would stall the loop just the same — and
+                # the whole point is ONE scheduled pause at a quiet moment
+                took = gcdiscipline.seal()
+                if took > 1.0:
+                    LOG.warning("%s: heap seal paused ~%.1fs (deliberate, "
+                                "post-bring-up)", self.peer_id, took)
+
+    def seal_heap(self) -> float:
+        """Imperative form of the janitor's seal, for operators/harnesses
+        that know bring-up just finished and prefer to take the one
+        deliberate pause NOW (the bench does)."""
+        from ratis_tpu.util import gcdiscipline
+        return gcdiscipline.seal()
 
     # -------------------------------------------------------- group mgmt
 
@@ -405,6 +454,9 @@ class RaftServer:
                 .segment_cache_num_max(self.properties))
         div = Division(self, group, sm, log=log, storage=storage)
         self.divisions[group.group_id] = div
+        if self._gc_disciplined:
+            from ratis_tpu.util import gcdiscipline
+            gcdiscipline.note_mutation()
         try:
             await div.start()
         except Exception:
@@ -425,6 +477,9 @@ class RaftServer:
         div = self.divisions.pop(group_id, None)
         if div is None:
             raise GroupMismatchException(f"{self.peer_id} does not host {group_id}")
+        if self._gc_disciplined:
+            from ratis_tpu.util import gcdiscipline
+            gcdiscipline.note_mutation()
         await div.state_machine.notify_group_remove()
         storage = div.storage
         await div.close()

@@ -81,6 +81,10 @@ def bench_properties(batched: bool, num_groups: int = 1,
     p.set(RaftServerConfigKeys.Engine.MAX_GROUPS_KEY,
           str(max(QuorumEngine._bucket(num_groups), 64)))
     RaftServerConfigKeys.Log.set_use_memory(p, True)
+    # server-level heap discipline (tuned thresholds + idle-janitor seal;
+    # the harness calls seal_heap() right after bring-up instead of waiting
+    # out the idle window)
+    p.set(RaftServerConfigKeys.Gc.DISCIPLINE_KEY, "true")
     if batched:
         # TPU-native execution mode: every tick runs the jitted kernel over
         # all groups, and append traffic toward each destination server is
@@ -349,17 +353,26 @@ async def _started_cluster(num_groups: int, batched: bool,
     post-bring-up heap out of the collector — a single gen-2 pass over the
     10k-group live heap measured 52s; the pause monitor caught it)."""
     import gc
-    gc.set_threshold(700, 1000, 1000)
+    # Bring-up allocates a few million long-lived objects; automatic gen-2
+    # passes over that growing heap measured 0.5-1.25s pauses at 4096
+    # 5-peer groups (they fire election timeouts -> storms) and tens of
+    # seconds at 10k+.  Nothing allocated during bring-up is garbage, so
+    # the harness runs with GC OFF while building, then takes the server
+    # runtime's one deliberate seal (raft.tpu.gc.discipline supplies the
+    # thresholds; RaftServer.seal_heap is the production knob — a server
+    # without this harness gets the same seal from its idle janitor).
+    gc.disable()
     cluster = BenchCluster(num_groups, num_servers=num_servers,
                            batched=batched, transport=transport,
                            sm=sm, datastream=datastream,
                            hibernate=hibernate)
     try:
         await cluster.start()
-        gc.collect()
-        gc.freeze()
+        cluster.servers[0].seal_heap()
+        gc.enable()
         yield cluster
     finally:
+        gc.enable()
         await cluster.close()
 
 

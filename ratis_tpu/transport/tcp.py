@@ -80,11 +80,20 @@ class TcpTlsConfig:
         from ratis_tpu.conf.keys import NettyConfigKeys
         if p is None or not NettyConfigKeys.Tls.enabled(p):
             return None
-        return TcpTlsConfig(
+        cfg = TcpTlsConfig(
             cert_chain_path=NettyConfigKeys.Tls.cert_chain(p),
             private_key_path=NettyConfigKeys.Tls.private_key(p),
             trust_root_path=NettyConfigKeys.Tls.trust_root(p),
             mutual_auth=NettyConfigKeys.Tls.mutual_auth(p))
+        if not cfg.trust_root_path:
+            # Once per configuration, not per connection: encryption without
+            # server authentication is a silent downgrade (MITM-able); the
+            # gRPC path refuses to run without explicit cert material.
+            LOG.warning(
+                "TLS enabled WITHOUT a trust root (*.tls.trust.root.path "
+                "unset): connections are encrypted but the server is NOT "
+                "authenticated — configure a trust root for production")
+        return cfg
 
     def server_context(self):
         import ssl
@@ -106,6 +115,8 @@ class TcpTlsConfig:
             ctx.load_verify_locations(self.trust_root_path)
             ctx.verify_mode = ssl.CERT_REQUIRED
         else:
+            # no trust root: encrypted but unauthenticated — warned once at
+            # from_properties time
             ctx.verify_mode = ssl.CERT_NONE
         if self.mutual_auth and self.cert_chain_path:
             ctx.load_cert_chain(self.cert_chain_path, self.private_key_path)

@@ -46,13 +46,15 @@ def test_idle_group_hibernates_and_wakes_on_write():
     async def body(cluster: MiniCluster):
         assert (await cluster.send_write()).success
         leader = await _wait_hibernated(cluster)
-        # followers' election timers are disarmed
+        # followers' election timers hold the LONG backstop deadline, far
+        # past any normal election timeout (full disarm only at backstop=0)
         for d in cluster.divisions():
             if d is leader:
                 continue
             eng = cluster.servers[d.member_id.peer_id].engine
-            assert int(eng.state.election_deadline_ms[d.engine_slot]) \
-                == NO_DEADLINE
+            dl = int(eng.state.election_deadline_ms[d.engine_slot])
+            assert d._hibernated_follower
+            assert dl - eng.clock.now_ms() > 10_000
         # heartbeat traffic STOPS: bulk item counts freeze
         before = sum(s.heartbeats.metrics["heartbeats"]
                      for s in cluster.servers.values())
@@ -106,6 +108,51 @@ def test_dead_hibernated_leader_recovers_on_client_contact():
         assert any(d.is_leader() for d in cluster.divisions())
 
     run_with_new_cluster(3, body, properties=_hibernate_properties())
+
+
+def test_backstop_elects_after_leader_death_without_contact():
+    """Dead-leader backstop: with zero client traffic, a hibernated
+    group whose leader dies re-elects within ~backstop — the slow-tick
+    refreshes stop, the followers' long deadlines expire, and a normal
+    election runs (round-4 advisor: full disarm left such a group
+    leaderless forever)."""
+
+    async def body(cluster: MiniCluster):
+        assert (await cluster.send_write()).success
+        leader = await _wait_hibernated(cluster)
+        await cluster.kill_server(leader.member_id.peer_id)
+        # NO client contact at all: the backstop alone must recover it
+        deadline = asyncio.get_event_loop().time() + 12.0
+        while asyncio.get_event_loop().time() < deadline:
+            if any(d.is_leader() for d in cluster.divisions()):
+                break
+            await asyncio.sleep(0.05)
+        assert any(d.is_leader() for d in cluster.divisions()), \
+            "backstop never made the group electable again"
+        assert (await cluster.send_write()).success
+
+    p = _hibernate_properties()
+    p.set(RaftServerConfigKeys.Hibernate.BACKSTOP_KEY, "1500ms")
+    run_with_new_cluster(3, body, properties=p)
+
+
+def test_backstop_slow_tick_keeps_healthy_group_asleep():
+    """The slow tick is not a wake: a HEALTHY sleeping group rides
+    through several backstop periods without elections, leadership
+    movement, or falling out of hibernation."""
+
+    async def body(cluster: MiniCluster):
+        assert (await cluster.send_write()).success
+        leader = await _wait_hibernated(cluster)
+        term = leader.state.current_term
+        await asyncio.sleep(2.5)  # >= 2 full backstop periods
+        assert leader.is_leader() and leader._hibernating
+        assert leader.state.current_term == term, \
+            "slow tick triggered an election in a healthy sleeping group"
+
+    p = _hibernate_properties()
+    p.set(RaftServerConfigKeys.Hibernate.BACKSTOP_KEY, "1s")
+    run_with_new_cluster(3, body, properties=p)
 
 
 def test_hibernated_group_partition_safety():
