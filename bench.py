@@ -53,13 +53,41 @@ def _force_cpu_platform() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def _gc_log() -> None:
+    """RATIS_BENCH_GCLOG=1: attribute event-loop pauses to collector passes
+    (prints any automatic collection slower than 0.2s with its generation)."""
+    import gc
+    import time as _t
+    state = {}
+
+    def cb(phase, info):
+        if phase == "start":
+            state["t0"] = _t.monotonic()
+        else:
+            took = _t.monotonic() - state.get("t0", _t.monotonic())
+            if took > 0.2:
+                print(f"bench: gc gen{info['generation']} took {took:.2f}s "
+                      f"(collected {info['collected']})",
+                      file=sys.stderr, flush=True)
+
+    gc.callbacks.append(cb)
+
+
 def child_e2e(spec: str) -> None:
+    cfg = json.loads(spec)
+    if os.environ.get("RATIS_BENCH_GCLOG"):
+        _gc_log()
+    mesh = cfg.get("mesh", 0)
+    if mesh:
+        # must land before any jax backend init: the sharded resident
+        # engine needs an n-device (virtual CPU) mesh in this child
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = \
+            f"{flags} --xla_force_host_platform_device_count={mesh}".strip()
     _force_cpu_platform()
     import asyncio
 
     from ratis_tpu.tools.bench_cluster import run_bench
-
-    cfg = json.loads(spec)
 
     async def main():
         out = await run_bench(cfg["groups"], cfg["writes"],
@@ -71,8 +99,14 @@ def child_e2e(spec: str) -> None:
                               num_servers=cfg.get("peers", 3),
                               hibernate=cfg.get("hibernate", False),
                               active_groups=cfg.get("active"),
-                              settle_s=cfg.get("settle", 0.0))
-        print("RESULT " + json.dumps(out))
+                              settle_s=cfg.get("settle", 0.0),
+                              mesh_devices=mesh,
+                              teardown=False)
+        print("RESULT " + json.dumps(out), flush=True)
+        # measurement children skip the graceful unwind: closing 50k
+        # divisions ran LONGER than the measurement itself; process exit
+        # reclaims everything (in-memory logs, sim/localhost sockets)
+        os._exit(0)
 
     asyncio.run(main())
 

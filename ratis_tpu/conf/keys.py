@@ -443,6 +443,16 @@ class RaftServerConfigKeys:
         DISCIPLINE_DEFAULT = False
         FREEZE_IDLE_KEY = "raft.tpu.gc.freeze-idle"
         FREEZE_IDLE_DEFAULT = TimeDuration.valueOf("10s")
+        # Steady-state re-seal cadence (0 = off).  A loaded multi-raft host
+        # accretes long-lived objects (log entries) that are never garbage
+        # but are walked by every young-gen pass: measured at 5-peer x
+        # 10240 groups, gen-1 collections burned 0.3-0.5s each COLLECTING
+        # ZERO.  Periodic re-freezing moves the accreted live set out of
+        # the collector.  Trade (document before enabling): frozen objects
+        # are never reclaimed, so workloads that DROP long-lived state
+        # (log purge after snapshot) leak it until close.
+        REFREEZE_INTERVAL_KEY = "raft.tpu.gc.refreeze-interval"
+        REFREEZE_INTERVAL_DEFAULT = TimeDuration.valueOf("0s")
 
         @staticmethod
         def discipline(p: RaftProperties) -> bool:
@@ -455,6 +465,12 @@ class RaftServerConfigKeys:
             return p.get_time_duration(
                 RaftServerConfigKeys.Gc.FREEZE_IDLE_KEY,
                 RaftServerConfigKeys.Gc.FREEZE_IDLE_DEFAULT)
+
+        @staticmethod
+        def refreeze_interval(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_KEY,
+                RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_DEFAULT)
 
     class Notification:
         NO_LEADER_TIMEOUT_KEY = "raft.server.notification.no-leader.timeout"
@@ -485,6 +501,11 @@ class RaftServerConfigKeys:
         # 0 = single-device.  The mesh size must divide max-groups.
         MESH_DEVICES_KEY = "raft.tpu.engine.mesh-devices"
         MESH_DEVICES_DEFAULT = 0
+        # When set, the engine runs inside a jax.profiler trace written to
+        # this directory (XLA device ops + one named step per tick, for
+        # TensorBoard/xprof).  Empty = no profiling.  SURVEY §5 tracing.
+        PROFILE_DIR_KEY = "raft.tpu.engine.profile-dir"
+        PROFILE_DIR_DEFAULT = ""
 
         @staticmethod
         def tick_interval(p: RaftProperties) -> TimeDuration:
@@ -505,6 +526,11 @@ class RaftServerConfigKeys:
         def mesh_devices(p: RaftProperties) -> int:
             return p.get_int(RaftServerConfigKeys.Engine.MESH_DEVICES_KEY,
                              RaftServerConfigKeys.Engine.MESH_DEVICES_DEFAULT)
+
+        @staticmethod
+        def profile_dir(p: RaftProperties) -> str:
+            return p.get(RaftServerConfigKeys.Engine.PROFILE_DIR_KEY,
+                         RaftServerConfigKeys.Engine.PROFILE_DIR_DEFAULT)
 
 
 class GrpcConfigKeys:

@@ -18,6 +18,7 @@ kernels take over (the 10k-group path).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Callable, Optional, Protocol
 
@@ -31,6 +32,8 @@ from ratis_tpu.ops import reference as ref
 # keep in sync with ops.quorum.PACK_SENTINEL (not imported here: engine
 # import must not eagerly pull in jax)
 _PACK_SENTINEL = -(2 ** 31)
+
+LOG = logging.getLogger(__name__)
 
 _SHARED_STEP = None
 _SHARED_FAST_STEP = None
@@ -105,18 +108,27 @@ class Clock:
 
 
 class QuorumEngine:
+    # Which engine (if any) owns the process-wide jax profiler session:
+    # jax.profiler.start_trace is a singleton, and co-hosted servers each
+    # build an engine, so only the first profiled engine starts the trace.
+    _profiling_owner = None
+
     def __init__(self, max_groups: int = 1024, max_peers: int = 8,
                  tick_interval_s: float = 0.002,
                  scalar_fallback_threshold: int = 16,
                  leadership_timeout_ms: int = 300,
                  use_device: bool = False,
-                 mesh=None):
+                 mesh=None, profile_dir: Optional[str] = None):
         # Optional jax.sharding.Mesh: the PRODUCTION resident tick
         # (engine_step_resident / _fast, donated DeviceState) runs sharded
         # over the group axis — each device owns G/n rows, packed events
         # replicate, and the row-local quorum math keeps the step
         # collective-free (ratis_tpu.parallel.mesh).
         self.mesh = mesh
+        # SURVEY §5 tracing hook: when set, the engine runs inside a
+        # jax.profiler trace (XLA device ops + named tick steps) written to
+        # this directory for TensorBoard/xprof — raft.tpu.engine.profile-dir.
+        self.profile_dir = profile_dir
         self.state = GroupBatchState(max_groups, max_peers)
         self.clock = Clock()
         self.tick_interval_s = tick_interval_s
@@ -369,10 +381,25 @@ class QuorumEngine:
 
     async def start(self) -> None:
         self._running = True
+        if self.profile_dir and QuorumEngine._profiling_owner is None:
+            import jax
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                QuorumEngine._profiling_owner = self
+                LOG.info("engine profiling -> %s", self.profile_dir)
+            except Exception:
+                LOG.exception("could not start jax profiler trace")
         self._task = asyncio.create_task(self._run(), name="quorum-engine")
 
     async def close(self) -> None:
         self._running = False
+        if QuorumEngine._profiling_owner is self:
+            import jax
+            QuorumEngine._profiling_owner = None
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                LOG.exception("could not stop jax profiler trace")
         if self._task is not None:
             self._wake.set()
             self._task.cancel()
@@ -402,7 +429,17 @@ class QuorumEngine:
                     pass
             self._wake.clear()
             t0 = loop.time()
-            await self.tick()
+            if QuorumEngine._profiling_owner is self:
+                # named step in the xprof timeline (one per dispatch).
+                # ONLY the owning engine annotates: co-hosted engines share
+                # one process-wide trace, and three interleaved step_num
+                # sequences would make xprof's per-step view meaningless.
+                import jax
+                with jax.profiler.StepTraceAnnotation(
+                        "engine_tick", step_num=self.metrics["ticks"]):
+                    await self.tick()
+            else:
+                await self.tick()
             cost = loop.time() - t0
             if cost > self.tick_interval_s:
                 # Self-pacing: a dispatch that cost more than the tick

@@ -699,6 +699,41 @@ class Division:
         self._election_task = asyncio.create_task(
             _run_and_rearm(), name=f"election-{self.member_id}")
 
+    async def bootstrap_as_leader(self) -> None:
+        """Deployment-mode APPOINTED-LEADER bootstrap: install leadership
+        directly — term 1, self-vote persisted, startup conf entry,
+        appenders — with NO vote round.  For fresh groups only; the
+        followers adopt the term from the first heartbeat/append exactly as
+        they would after a won election.
+
+        Contract (operator-owned, like the reference's startup-role /
+        priority machinery that legitimizes operator-chosen initial
+        leaders, LeaderElection.java:80, RaftPeer startup roles): appoint
+        EXACTLY ONE peer per group, at group creation, before any traffic.
+        Two appointees would be two same-term leaders — the vote round
+        this skips is what normally forbids that.  Guarded to fresh state
+        so it can never fire on a group with history.
+
+        Why it exists: mass bring-up (the 10k-group multi-raft shape) pays
+        O(groups x peers) vote RPCs and election machinery for an outcome
+        the deployment already chose; measured at 5-peer x 10240 groups
+        this was the dominant bring-up cost."""
+        if not self.is_follower() or self.state.current_term != 0 \
+                or self.state.leader_id is not None \
+                or self.state.log.get_last_entry_term_index() is not None:
+            raise RaftException(
+                f"{self.member_id}: appointed bootstrap requires a fresh "
+                f"group (follower at term 0 with an empty log)")
+        if not self.state.configuration.contains_voting(
+                self.member_id.peer_id):
+            raise RaftException(
+                f"{self.member_id}: appointed bootstrap of a non-voting "
+                f"member")
+        await self.state.init_election_term()
+        self.role = RaftPeerRole.CANDIDATE
+        self._engine_set_role(ROLE_CANDIDATE)
+        await self.change_to_leader()
+
     async def change_to_leader(self) -> None:
         assert self.is_candidate()
         self.role = RaftPeerRole.LEADER

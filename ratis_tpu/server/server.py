@@ -142,15 +142,26 @@ class BulkHeartbeatService:
     surfaces through leadership staleness (no acks) exactly like a dead
     unary heartbeat channel would."""
 
+    # One BulkHeartbeat RPC carries at most this many group items: a
+    # 10k-item bulk is O(all co-hosted groups) handling time inside ONE
+    # rpc deadline — measured at 5-peer x 10240 groups, the whole bulk
+    # blew the rpc timeout, every ack was lost at once, and the staleness
+    # sweep deposed thousands of healthy leaders.  Chunks fail (and
+    # retry) independently.
+    MAX_ITEMS_PER_RPC = 2048
+
     def __init__(self, server: "RaftServer"):
         self.server = server
         self.metrics = {"batches": 0, "heartbeats": 0}
         self._pending: set[asyncio.Task] = set()
 
     def submit(self, to: RaftPeerId, items: list, appenders: list) -> None:
-        t = asyncio.create_task(self._send(to, items, appenders))
-        self._pending.add(t)
-        t.add_done_callback(self._pending.discard)
+        n = self.MAX_ITEMS_PER_RPC
+        for i in range(0, len(items), n):
+            t = asyncio.create_task(
+                self._send(to, items[i:i + n], appenders[i:i + n]))
+            self._pending.add(t)
+            t.add_done_callback(self._pending.discard)
 
     async def _send(self, to: RaftPeerId, items: list, appenders: list) -> None:
         from ratis_tpu.protocol.raftrpc import BulkHeartbeat
@@ -229,7 +240,8 @@ class RaftServer:
                 RaftServerConfigKeys.Engine.SCALAR_FALLBACK_THRESHOLD_DEFAULT),
             leadership_timeout_ms=int(
                 RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2),
-            mesh=mesh)
+            mesh=mesh,
+            profile_dir=RaftServerConfigKeys.Engine.profile_dir(p) or None)
         self.pause_monitor = None  # started in start() when enabled
         from ratis_tpu.conf.reconfiguration import ReconfigurationManager
         # live property reconfiguration (divisions register their knobs)
@@ -302,7 +314,9 @@ class RaftServer:
             gcdiscipline.enable()
             self._gc_disciplined = True
             self._gc_task = asyncio.create_task(
-                self._gc_janitor(_K.Gc.freeze_idle(self.properties).seconds),
+                self._gc_janitor(
+                    _K.Gc.freeze_idle(self.properties).seconds,
+                    _K.Gc.refreeze_interval(self.properties).seconds),
                 name=f"gc-janitor-{self.peer_id}")
         if _K.PauseMonitor.enabled(self.properties):
             from ratis_tpu.server.pause_monitor import PauseMonitor
@@ -379,17 +393,26 @@ class RaftServer:
         await self.engine.close()
         self.life_cycle.transition(LifeCycleState.CLOSED)
 
-    async def _gc_janitor(self, freeze_idle_s: float) -> None:
+    async def _gc_janitor(self, freeze_idle_s: float,
+                          refreeze_s: float = 0.0) -> None:
         """Waits for the group set to settle, then seals the heap (ONE
         deliberate collect+freeze) so the collector never walks the
-        division fleet again; re-seals after later add/remove bursts."""
-        if freeze_idle_s <= 0:
+        division fleet again; re-seals after later add/remove bursts, and
+        — when ``raft.tpu.gc.refreeze-interval`` is set — on a steady
+        cadence, moving load-accreted live objects (log entries) out of
+        every future young-gen walk."""
+        if freeze_idle_s <= 0 and refreeze_s <= 0:
             return
         from ratis_tpu.util import gcdiscipline
-        poll = max(min(freeze_idle_s / 2, 5.0), 0.05)
+        poll = max(min(freeze_idle_s / 2 if freeze_idle_s > 0 else 5.0,
+                       5.0), 0.05)
         while True:
             await asyncio.sleep(poll)
-            if gcdiscipline.seal_due(freeze_idle_s):
+            due = (freeze_idle_s > 0
+                   and gcdiscipline.seal_due(freeze_idle_s)) or \
+                  (refreeze_s > 0
+                   and gcdiscipline.refreeze_due(refreeze_s))
+            if due:
                 # inline on purpose: gc.collect holds the GIL throughout, so
                 # a worker thread would stall the loop just the same — and
                 # the whole point is ONE scheduled pause at a quiet moment
@@ -401,7 +424,14 @@ class RaftServer:
     def seal_heap(self) -> float:
         """Imperative form of the janitor's seal, for operators/harnesses
         that know bring-up just finished and prefer to take the one
-        deliberate pause NOW (the bench does)."""
+        deliberate pause NOW (the bench does).  No-op unless the server
+        runs with raft.tpu.gc.discipline: sealing without the discipline's
+        close-time thaw would freeze the division fleet permanently."""
+        if not self._gc_disciplined:
+            LOG.warning("%s: seal_heap ignored — raft.tpu.gc.discipline "
+                        "is off (nothing would ever unfreeze the heap)",
+                        self.peer_id)
+            return 0.0
         from ratis_tpu.util import gcdiscipline
         return gcdiscipline.seal()
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import itertools
+import sys
 import time
 from typing import Optional
 
@@ -45,26 +46,32 @@ from ratis_tpu.transport.simulated import (SimulatedNetwork,
 
 
 def bench_properties(batched: bool, num_groups: int = 1,
-                     hibernate: bool = False) -> RaftProperties:
+                     hibernate: bool = False,
+                     mesh_devices: int = 0,
+                     num_servers: int = 3) -> RaftProperties:
     from ratis_tpu.engine.engine import QuorumEngine
     p = RaftProperties()
-    # Timeouts scale with group density: background heartbeat volume is
-    # O(groups x followers / interval) (one appender per follower per
-    # group, like the reference), so a fixed 1s/2s that is fine at 64
-    # groups makes 1024+ co-hosted groups spend a third of the host on
-    # idle-channel upkeep.  Multi-raft deployments tune exactly this knob
-    # (election timeout up, heartbeat interval with it) as groups/host
-    # grows; both engine modes get the same setting, so the batched/scalar
-    # comparison is unaffected.
-    if num_groups >= 8192:
+    # Timeouts scale with CHANNEL density (groups x followers): background
+    # heartbeat volume is O(channels / interval) — one appender item per
+    # follower per group, like the reference — so a fixed 1s/2s that is
+    # fine at 64 groups makes thousands of co-hosted channels spend the
+    # whole host on idle upkeep (measured: 5-peer x 10240 = 40960 channels
+    # at an 8s/16s-derived 4s sweep saturated the loop on heartbeat item
+    # build+handle alone).  Multi-raft deployments tune exactly this knob
+    # as density grows; both engine modes get the same setting, so the
+    # batched/scalar comparison is unaffected.
+    channels = num_groups * max(num_servers - 1, 1)
+    if channels >= 32768:
+        RaftServerConfigKeys.Rpc.set_timeout(p, "16s", "32s")
+    elif channels >= 16384:
         RaftServerConfigKeys.Rpc.set_timeout(p, "8s", "16s")
-    elif num_groups >= 2048:
+    elif channels >= 4096:
         RaftServerConfigKeys.Rpc.set_timeout(p, "4s", "8s")
     else:
-        # 1s/2s at <=1024 groups: already ~7x the reference's default
-        # election timeouts (150-300ms, RaftServerConfigKeys.java) — the
-        # baseline's per-(group,follower) heartbeat channels get a generous
-        # but realistic idle cadence.
+        # 1s/2s at <=1024 3-peer groups: already ~7x the reference's
+        # default election timeouts (150-300ms, RaftServerConfigKeys.java)
+        # — the baseline's per-(group,follower) heartbeat channels get a
+        # generous but realistic idle cadence.
         RaftServerConfigKeys.Rpc.set_timeout(p, "1s", "2s")
     if batched:
         # Commits advance inline at ack intake (QuorumEngine.on_ack), so
@@ -85,6 +92,18 @@ def bench_properties(batched: bool, num_groups: int = 1,
     # the harness calls seal_heap() right after bring-up instead of waiting
     # out the idle window)
     p.set(RaftServerConfigKeys.Gc.DISCIPLINE_KEY, "true")
+    if channels >= 16384:
+        # steady-state re-freeze: the in-memory logs accrete live entries
+        # under load and young-gen passes were measured burning 0.3-0.5s
+        # each collecting ZERO at this density (memory log never purges,
+        # so the refreeze leak trade is moot here)
+        p.set(RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_KEY, "20s")
+    if mesh_devices:
+        # shard the resident engine state over the group axis of an
+        # n-device mesh (parallel/mesh.py; the rung that gives sharding a
+        # measured e2e number, not just dryrun bit-identity)
+        p.set(RaftServerConfigKeys.Engine.MESH_DEVICES_KEY,
+              str(mesh_devices))
     if batched:
         # TPU-native execution mode: every tick runs the jitted kernel over
         # all groups, and append traffic toward each destination server is
@@ -114,13 +133,14 @@ class BenchCluster:
     def __init__(self, num_groups: int, num_servers: int = 3,
                  batched: bool = True, transport: str = "sim",
                  sm: str = "counter", datastream: bool = False,
-                 hibernate: bool = False):
+                 hibernate: bool = False, mesh_devices: int = 0):
         self.num_groups = num_groups
         self.batched = batched
         self.transport = transport
         self.sm = sm
         self.datastream = datastream
         self.hibernate = hibernate
+        self.mesh_devices = mesh_devices
         if transport in ("tcp", "grpc"):
             # Real localhost sockets: every RPC pays framing + syscalls, so
             # the per-(group,follower) stream shape costs what it costs the
@@ -152,7 +172,17 @@ class BenchCluster:
         else:
             raise ValueError(f"unknown bench transport {transport!r}")
         self.properties = bench_properties(batched, num_groups,
-                                           hibernate=hibernate)
+                                           hibernate=hibernate,
+                                           mesh_devices=mesh_devices,
+                                           num_servers=num_servers)
+        if self.network is not None:
+            # the sim's default 3s rpc deadline models a small cluster; a
+            # legitimately-busy handler at thousands of co-hosted groups
+            # (coalesced envelope / bulk chunk on a saturated loop) gets
+            # the same density-scaled deadline the real transports get
+            self.network.request_timeout_s = max(
+                3.0, RaftServerConfigKeys.Rpc.timeout_min(
+                    self.properties).seconds)
         self.groups = [RaftGroup.value_of(RaftGroupId.random_id(), peers)
                        for _ in range(num_groups)]
         if sm == "filestore":
@@ -200,18 +230,19 @@ class BenchCluster:
             self.prewarm_s = time.monotonic() - tw
         t0 = time.monotonic()
         await asyncio.gather(*(s.start() for s in self.servers))
-        # Wave-wise group bring-up with OPERATOR-TRIGGERED elections: after
-        # each wave's group-add, server 0's divisions force an immediate
-        # election (the reference's startLeaderElection admin path,
-        # RaftServerImpl.java:1735) instead of every group waiting out a
-        # randomized 1-2s timeout — 1024 deliberate timeout storms through
-        # one event loop was the old 30s bring-up.  The timeout path stays
-        # as the fallback for any group whose forced election loses a race.
+        # Wave-wise group bring-up with APPOINTED-LEADER bootstrap: after
+        # each wave's group-add, server 0's fresh divisions install
+        # leadership directly (Division.bootstrap_as_leader — the
+        # deployment mode where the operator chose the initial leader) —
+        # no vote rounds at all.  At 10k 5-peer groups the per-group
+        # election machinery (vote RPC fan-out + reply handling x 51200
+        # divisions) was the dominant bring-up cost; randomized-timeout
+        # elections remain as the fallback for any division the bootstrap
+        # cannot claim (non-fresh state).
         import os
-        import sys
         trace = os.environ.get("RATIS_BENCH_TRACE")
         wave = 128
-        await self._force_elections([self.groups[0]])
+        await self._appoint_leaders([self.groups[0]])
         await self._wait_all_leaders([self.groups[0]])
         for i in range(1, len(self.groups), wave):
             batch = self.groups[i:i + wave]
@@ -219,7 +250,7 @@ class BenchCluster:
             await asyncio.gather(*(s.group_add(g) for g in batch
                                    for s in self.servers))
             t_add = time.monotonic() - tw
-            await self._force_elections(batch)
+            await self._appoint_leaders(batch)
             await self._wait_all_leaders(batch)
             if trace:
                 print(f"bench: wave@{i} add={t_add:.2f}s "
@@ -227,14 +258,18 @@ class BenchCluster:
                       file=sys.stderr, flush=True)
         self.election_convergence_s = time.monotonic() - t0
 
-    async def _force_elections(self, groups: list[RaftGroup]) -> None:
-        starts = []
+    async def _appoint_leaders(self, groups: list[RaftGroup]) -> None:
+        boots = []
         for g in groups:
             d = self.servers[0].divisions.get(g.group_id)
             if d is not None and d.is_follower():
-                starts.append(d.change_to_candidate(force=True))
-        if starts:
-            await asyncio.gather(*starts, return_exceptions=True)
+                boots.append(d.bootstrap_as_leader())
+        if boots:
+            results = await asyncio.gather(*boots, return_exceptions=True)
+            for r in results:
+                if isinstance(r, BaseException):
+                    print(f"bench: bootstrap fell back to election: {r}",
+                          file=sys.stderr, flush=True)
 
     async def _wait_all_leaders(self, groups: list[RaftGroup],
                                 timeout: float = 120.0) -> None:
@@ -266,8 +301,12 @@ class BenchCluster:
     # ------------------------------------------------------------- workload
 
     async def _write(self, client, client_id: ClientId, gid: RaftGroupId,
-                     timeout: float = 60.0, message: bytes = b"INCREMENT"):
+                     timeout: float = 0.0, message: bytes = b"INCREMENT"):
         """One write with leader-hint failover."""
+        if not timeout:
+            # a saturated 10k-group loop can starve one write past a fixed
+            # 60s while the aggregate is perfectly healthy
+            timeout = 60.0 if self.num_groups < 8192 else 240.0
         server = self._leader_hint.get(gid, self.servers[0])
         deadline = time.monotonic() + timeout
         while True:
@@ -312,6 +351,9 @@ class BenchCluster:
         target_groups = (self.groups if active_groups is None
                          else self.groups[:active_groups])
 
+        import os
+        trace = os.environ.get("RATIS_BENCH_TRACE")
+
         async def group_load(g: RaftGroup):
             client_id = ClientId.random_id()
             for _ in range(writes_per_group):
@@ -322,6 +364,10 @@ class BenchCluster:
                     await self._write(client, client_id, g.group_id,
                                       message=msg)
                     latencies.append(time.monotonic() - t0)
+                    if trace and len(latencies) % 4096 == 0:
+                        print(f"bench: {len(latencies)} writes done "
+                              f"({len(latencies) / (time.monotonic() - t_start):.0f}/s)",
+                              file=sys.stderr, flush=True)
 
         t_start = time.monotonic()
         await asyncio.gather(*(group_load(g) for g in target_groups))
@@ -347,7 +393,7 @@ class BenchCluster:
 async def _started_cluster(num_groups: int, batched: bool,
                            transport: str = "sim", sm: str = "counter",
                            datastream: bool = False, num_servers: int = 3,
-                           hibernate: bool = False):
+                           hibernate: bool = False, mesh_devices: int = 0):
     """Shared rung scaffold: build + start the cluster with the GC tuning
     every rung needs (defer gen-2 cascades during bring-up, then freeze the
     post-bring-up heap out of the collector — a single gen-2 pass over the
@@ -362,18 +408,21 @@ async def _started_cluster(num_groups: int, batched: bool,
     # thresholds; RaftServer.seal_heap is the production knob — a server
     # without this harness gets the same seal from its idle janitor).
     gc.disable()
-    cluster = BenchCluster(num_groups, num_servers=num_servers,
-                           batched=batched, transport=transport,
-                           sm=sm, datastream=datastream,
-                           hibernate=hibernate)
+    cluster = None
     try:
+        cluster = BenchCluster(num_groups, num_servers=num_servers,
+                               batched=batched, transport=transport,
+                               sm=sm, datastream=datastream,
+                               hibernate=hibernate,
+                               mesh_devices=mesh_devices)
         await cluster.start()
         cluster.servers[0].seal_heap()
         gc.enable()
         yield cluster
     finally:
         gc.enable()
-        await cluster.close()
+        if cluster is not None:
+            await cluster.close()
 
 
 async def run_bench(num_groups: int, writes_per_group: int,
@@ -381,12 +430,19 @@ async def run_bench(num_groups: int, writes_per_group: int,
                     warmup_writes: int = 1, transport: str = "sim",
                     sm: str = "counter", num_servers: int = 3,
                     hibernate: bool = False, active_groups=None,
-                    settle_s: float = 0.0) -> dict:
+                    settle_s: float = 0.0, mesh_devices: int = 0,
+                    teardown: bool = True) -> dict:
     """One ladder rung: build the ``num_servers``-server cluster, elect,
-    warm up, measure, tear down."""
-    async with _started_cluster(num_groups, batched, transport=transport,
-                                sm=sm, num_servers=num_servers,
-                                hibernate=hibernate) as cluster:
+    warm up, measure, tear down.  ``teardown=False`` skips the graceful
+    close: a measurement child that exits right after reporting has no
+    business spending minutes unwinding 50k divisions (measured: the
+    5-peer 10240 rung's close ran LONGER than its measurement; the OS
+    reclaims an exiting process instantly)."""
+    cm = _started_cluster(num_groups, batched, transport=transport,
+                          sm=sm, num_servers=num_servers,
+                          hibernate=hibernate, mesh_devices=mesh_devices)
+    cluster = await cm.__aenter__()
+    try:
         if hibernate and settle_s:
             # let idle groups actually fall asleep before measuring
             await asyncio.sleep(settle_s)
@@ -419,6 +475,9 @@ async def run_bench(num_groups: int, writes_per_group: int,
                 1 for s2 in cluster.servers
                 for d in s2.divisions.values() if d._hibernating)
         return result
+    finally:
+        if teardown:
+            await cm.__aexit__(None, None, None)
 
 
 async def run_churn_bench(num_groups: int, writes_per_group: int,
@@ -461,8 +520,18 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
                         cluster._leader_hint.pop(g.group_id, None)
                     else:
                         churn_stats["failed"] += 1
-                except Exception:
+                        exc = reply.exception
+                        churn_stats.setdefault("failures", []).append(
+                            type(exc).__name__ if exc else "no-exception")
+                        print(f"bench: transfer {g.group_id} -> {target} "
+                              f"REJECTED: {exc}", file=sys.stderr, flush=True)
+                except Exception as e:
                     churn_stats["failed"] += 1
+                    churn_stats.setdefault("failures", []).append(
+                        type(e).__name__)
+                    print(f"bench: transfer {g.group_id} -> {target} "
+                          f"FAILED: {type(e).__name__}: {e}",
+                          file=sys.stderr, flush=True)
                 await asyncio.sleep(0.02)
 
         churn_task = asyncio.create_task(churn())
@@ -472,6 +541,7 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
         result["mode"] = "batched" if batched else "scalar"
         result["transfers_ok"] = churn_stats["ok"]
         result["transfers_failed"] = churn_stats["failed"]
+        result["transfer_failures"] = churn_stats.get("failures", [])
         return result
 
 
@@ -514,9 +584,20 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
                     stream_stats["ok"] += 1
                     stream_stats["bytes"] += stream_bytes
                 else:
+                    # CLASSIFIED, never silent: a failing stream under load
+                    # is a correctness signal, not a throughput footnote
                     stream_stats["failed"] += 1
-            except Exception:
+                    exc = type(reply.exception).__name__ \
+                        if reply.exception else "no-exception"
+                    stream_stats.setdefault("failures", []).append(exc)
+                    print(f"bench: stream {i} REJECTED: {exc}: "
+                          f"{reply.exception}", file=sys.stderr, flush=True)
+            except Exception as e:
                 stream_stats["failed"] += 1
+                stream_stats.setdefault("failures", []).append(
+                    type(e).__name__)
+                print(f"bench: stream {i} FAILED: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
             finally:
                 await client.close()
 
@@ -545,7 +626,74 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
         result["mode"] = "batched" if batched else "scalar"
         result["streams_ok"] = stream_stats["ok"]
         result["streams_failed"] = stream_stats["failed"]
+        result["stream_failures"] = stream_stats.get("failures", [])
         result["stream_mb_per_s"] = round(
             stream_stats["bytes"]
             / max(stream_stats["elapsed_s"], 1e-9) / (1 << 20), 2)
         return result
+
+
+async def run_stream_throughput_bench(streams: int, stream_mb: int,
+                                      packet_kb: int = 1024,
+                                      window: int = 32) -> dict:
+    """Dedicated DataStream THROUGHPUT rung: few concurrent streams moving
+    tens of MB each over real TCP with big packets — the bulk-bytes job the
+    out-of-band plane exists for (reference NettyClientStreamRpc /
+    DataStreamManagement; the mixed rung measures coexistence with raft
+    load, this one measures the pipe)."""
+    import msgpack
+
+    from ratis_tpu.client import RaftClient
+
+    async with _started_cluster(max(streams, 4), True, sm="filestore",
+                                datastream=True) as cluster:
+        stream_bytes = stream_mb << 20
+        packet = packet_kb << 10
+        payload = b"\x5a" * packet
+        stats = {"ok": 0, "failed": 0, "bytes": 0, "failures": []}
+
+        async def one(i: int):
+            g = cluster.groups[i % len(cluster.groups)]
+            client = (RaftClient.builder()
+                      .set_raft_group(g)
+                      .set_transport(cluster.factory.new_client_transport(
+                          cluster.properties))
+                      .set_properties(cluster.properties)
+                      .build())
+            try:
+                cmd = msgpack.packb({"op": "stream", "path": f"bulk-{i}.bin"},
+                                    use_bin_type=True)
+                out = await client.data_stream().stream(cmd, window=window)
+                for _ in range(stream_bytes // packet):
+                    await out.write_async(payload)
+                reply = await out.close_async()
+                if reply.success:
+                    stats["ok"] += 1
+                    stats["bytes"] += stream_bytes
+                else:
+                    stats["failed"] += 1
+                    stats["failures"].append(
+                        type(reply.exception).__name__
+                        if reply.exception else "no-exception")
+            except Exception as e:
+                stats["failed"] += 1
+                stats["failures"].append(type(e).__name__)
+                print(f"bench: bulk stream {i} FAILED: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+            finally:
+                await client.close()
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(one(i) for i in range(streams)))
+        elapsed = time.monotonic() - t0
+        return {
+            "streams": streams,
+            "stream_mb": stream_mb,
+            "packet_kb": packet_kb,
+            "streams_ok": stats["ok"],
+            "streams_failed": stats["failed"],
+            "stream_failures": stats["failures"],
+            "stream_mb_per_s": round(
+                stats["bytes"] / max(elapsed, 1e-9) / (1 << 20), 2),
+            "elapsed_s": round(elapsed, 2),
+        }

@@ -41,14 +41,19 @@ _active = 0                 # servers with discipline enabled
 _saved_thresholds = None    # thresholds to restore when _active drops to 0
 _mutation_clock = 0.0       # monotonic time of the last group-set mutation
 _sealed_at = -1.0           # _mutation_clock value covered by the last seal
+_last_seal_s = 0.0          # monotonic time of the last seal (any cause)
 
 
 def enable() -> None:
     """Apply the thresholds (idempotent; refcounted across servers)."""
-    global _active, _saved_thresholds
+    global _active, _saved_thresholds, _last_seal_s
     if _active == 0:
         _saved_thresholds = gc.get_threshold()
         gc.set_threshold(*_DISCIPLINE_THRESHOLDS)
+        # the refreeze cadence counts from server start, not process
+        # start — otherwise the first interval is already elapsed and the
+        # re-seal fires mid-bring-up, the exact window it must avoid
+        _last_seal_s = time.monotonic()
     _active += 1
 
 
@@ -79,12 +84,19 @@ def seal_due(idle_s: float) -> bool:
     return time.monotonic() - _mutation_clock >= idle_s
 
 
+def refreeze_due(interval_s: float) -> bool:
+    """Process-global steady-state cadence gate: several in-process
+    servers' janitors share one collector, so one seal serves them all."""
+    return time.monotonic() - _last_seal_s >= interval_s
+
+
 def seal() -> float:
     """One deliberate full collection + freeze; returns its duration so
     callers can log/assert the pause they chose to take now instead of
     letting the collector take it mid-consensus later."""
-    global _sealed_at
+    global _sealed_at, _last_seal_s
     _sealed_at = _mutation_clock
+    _last_seal_s = time.monotonic()
     t0 = time.monotonic()
     gc.collect()
     gc.freeze()

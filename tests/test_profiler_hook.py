@@ -1,0 +1,31 @@
+"""XLA profiler hook (raft.tpu.engine.profile-dir, SURVEY §5 tracing):
+the engine wraps its run in a jax.profiler trace with one named step per
+tick, written for TensorBoard/xprof."""
+
+import asyncio
+import glob
+
+from minicluster import MiniCluster, batched_properties, run_with_new_cluster
+from ratis_tpu.conf.keys import RaftServerConfigKeys
+
+
+def test_profile_dir_produces_xla_trace(tmp_path):
+    trace_dir = str(tmp_path / "prof")
+
+    async def body(cluster: MiniCluster):
+        from ratis_tpu.engine.engine import QuorumEngine
+        assert QuorumEngine._profiling_owner is not None, \
+            "no engine took profiler ownership"
+        assert (await cluster.send_write()).success
+        await asyncio.sleep(0.2)  # a few ticks inside the trace
+
+    p = batched_properties()
+    p.set(RaftServerConfigKeys.Engine.PROFILE_DIR_KEY, trace_dir)
+    run_with_new_cluster(3, body, properties=p)
+
+    # stop_trace (at server close) materializes the xplane dump
+    dumps = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    assert dumps, f"no xplane trace written under {trace_dir}"
+
+    from ratis_tpu.engine.engine import QuorumEngine
+    assert QuorumEngine._profiling_owner is None, "ownership not released"
