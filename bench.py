@@ -84,7 +84,15 @@ def child_e2e(spec: str) -> None:
         flags = os.environ.get("XLA_FLAGS", "")
         os.environ["XLA_FLAGS"] = \
             f"{flags} --xla_force_host_platform_device_count={mesh}".strip()
-    _force_cpu_platform()
+    if cfg.get("platform") == "tpu":
+        # engine on the REAL chip: measured tunnel round-trip for a full
+        # [10240 x 8] engine tick is ~0.11ms (tiny dispatch 0.04ms, packed
+        # event upload 0.15ms), so the r4 assumption that e2e-on-TPU would
+        # only measure the tunnel was wrong — leave the default (axon)
+        # platform so every engine dispatch lands on the device
+        pass
+    else:
+        _force_cpu_platform()
     import asyncio
 
     from ratis_tpu.tools.bench_cluster import run_bench
@@ -124,6 +132,49 @@ def child_churn() -> None:
         print("RESULT " + json.dumps(out))
 
     asyncio.run(main())
+
+
+def child_stream() -> None:
+    """Dedicated DataStream THROUGHPUT rung: few big streams, real TCP
+    (run_stream_throughput_bench)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_stream_throughput_bench
+
+    async def main():
+        out = await run_stream_throughput_bench(4, 32, packet_kb=1024)
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
+def child_kernel_100k() -> None:
+    """BASELINE config 5 scale probe (engine axis): one fused engine_step
+    over a [100k groups x 8 peers] batch — the device-side capacity at
+    config 5's group count, independent of host-runtime limits."""
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _example_batch
+    from ratis_tpu.ops import quorum as q
+
+    G, P, E = 102_400, 8, 8192
+    args = _example_batch(G, P, E)
+    device_args = [jnp.asarray(a) for a in args]
+    step = jax.jit(q.engine_step)
+    out = step(*device_args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    iters = 10
+    for _ in range(iters):
+        out = step(*device_args)
+    jax.block_until_ready(out)
+    rate = G * iters / (time.perf_counter() - t0)
+    print("RESULT " + json.dumps({
+        "group_updates_per_sec_100k": round(rate, 1),
+        "platform": str(jax.devices()[0]),
+    }))
 
 
 def child_mixed() -> None:
@@ -192,17 +243,32 @@ def child_kernel() -> None:
     }))
 
 
-def _run_child(args: list[str], timeout_s: float = 900.0) -> dict:
+def _run_child(args: list[str], timeout_s: float = 900.0,
+               allow_dnf: bool = False) -> dict:
     t0 = time.monotonic()
     print(f"bench: running {args} ...", file=sys.stderr, flush=True)
-    proc = subprocess.run(
-        [sys.executable, __file__] + args, capture_output=True, text=True,
-        timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__] + args, capture_output=True,
+            text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        if allow_dnf:
+            print(f"bench: {args} DNF after {timeout_s:.0f}s",
+                  file=sys.stderr, flush=True)
+            return {"dnf": True, "timeout_s": timeout_s}
+        raise
     for line in proc.stdout.splitlines():
         if line.startswith("RESULT "):
             print(f"bench: {args} done in {time.monotonic() - t0:.0f}s",
                   file=sys.stderr, flush=True)
             return json.loads(line[len("RESULT "):])
+    if allow_dnf:
+        print(f"bench: {args} DNF (rc={proc.returncode})",
+              file=sys.stderr, flush=True)
+        return {"dnf": True,
+                "reason": proc.stderr.strip().splitlines()[-1][-200:]
+                if proc.stderr.strip() else f"rc={proc.returncode}"}
     raise RuntimeError(
         f"child {args} produced no RESULT; rc={proc.returncode} "
         f"stderr tail: {proc.stderr[-2000:]}")
@@ -236,8 +302,10 @@ def main() -> None:
     # Writes are scaled so every rung measures a comparable steady-state
     # window (~8k commits) instead of a burst.
     ladder: dict[int, list[dict]] = {}
-    for groups, writes, conc in ((1, 256, 32), (64, 128, 128),
-                                 (1024, 8, 128), (10_240, 2, 128)):
+    for groups, writes, conc, trials in ((1, 256, 32, 2),
+                                         (64, 128, 128, 2),
+                                         (1024, 8, 128, TRIALS),
+                                         (10_240, 2, 128, 1)):
         if groups in ladder:
             continue
         spec = json.dumps({"groups": groups, "writes": writes,
@@ -246,8 +314,30 @@ def main() -> None:
                            # leader hints come from bring-up; a warmup pass
                            # at 10k groups doubles the rung's wall-clock
                            "warmup": 0 if groups > 4096 else 1})
-        trials = TRIALS if groups <= HEADLINE_GROUPS else 1
         ladder[groups] = _run_trials(spec, trials, timeout_s=1800.0)
+
+    # NORTH STAR (BASELINE config 3's true shape): 5-peer x 10240 groups.
+    # Appointed-leader bootstrap + gc discipline + bulk chunking brought
+    # bring-up from >29min (r4 boundary) to ~2min.
+    peer5 = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 2, "batched": True,
+         "concurrency": 128, "transport": "sim", "peers": 5,
+         "warmup": 0})], timeout_s=1800.0)
+
+    # Config 5 probe: the 7-peer shape at reduced group count, plus the
+    # engine capacity at the full 100k-group count (kernel child below).
+    peer7 = _run_child(["--e2e-child", json.dumps(
+        {"groups": 2048, "writes": 4, "batched": True,
+         "concurrency": 128, "transport": "sim", "peers": 7,
+         "warmup": 0})], timeout_s=1800.0)
+
+    # Mesh rung: the sharded resident engine (8 virtual CPU devices) vs
+    # the single-device engine at 10240 groups — SURVEY §7 hard part 1
+    # gets an e2e number, not just dryrun bit-identity.
+    mesh = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 2, "batched": True,
+         "concurrency": 128, "transport": "sim", "warmup": 0,
+         "mesh": 8})], timeout_s=1800.0, allow_dnf=True)
 
     # HEADLINE: real localhost TCP sockets, batched vs scalar.
     tcp_spec = json.dumps({"groups": HEADLINE_GROUPS,
@@ -258,15 +348,22 @@ def main() -> None:
                               "writes": WRITES_PER_GROUP, "batched": False,
                               "concurrency": 128, "transport": "tcp"})
     scalar = _run_trials(scalar_spec, TRIALS)
-    # gRPC rung: proves the coalesced AppendEnvelope/BulkHeartbeat paths
-    # survive the grpc.aio transport (the reference's primary RPC stack
-    # analog) under load, batched vs scalar at 256 groups.
+    # gRPC at HEADLINE scale (the reference's primary RPC stack analog):
+    # batched envelopes+streams at 1024 groups; the scalar
+    # per-(group,follower) unary shape is attempted at the same scale and
+    # recorded as DNF when it cannot even bring up (measured: deadline
+    # storms at >=512 groups), with its largest completing scale below.
     grpc_b = _run_trials(json.dumps({
-        "groups": 256, "writes": 8, "batched": True, "sm": "arithmetic",
+        "groups": 1024, "writes": 8, "batched": True, "sm": "arithmetic",
         "concurrency": 128, "transport": "grpc"}), TRIALS)
-    grpc_s = _run_trials(json.dumps({
+    grpc_s_1024 = _run_child(["--e2e-child", json.dumps({
+        "groups": 1024, "writes": 8, "batched": False, "sm": "arithmetic",
+        "concurrency": 128, "transport": "grpc"})], timeout_s=420.0,
+        allow_dnf=True)
+    grpc_s_256 = _run_child(["--e2e-child", json.dumps({
         "groups": 256, "writes": 8, "batched": False, "sm": "arithmetic",
-        "concurrency": 128, "transport": "grpc"}), TRIALS)
+        "concurrency": 128, "transport": "grpc"})], timeout_s=600.0,
+        allow_dnf=True)
     # Sparse multi-tenant shape: 10240 hosted groups, 1024 actively
     # written, the rest idle — idle-group hibernation (no reference
     # analog; off in every other rung) vs the same shape without it.
@@ -280,7 +377,10 @@ def main() -> None:
          "settle": 20})], timeout_s=1800.0)
     churn = _run_child(["--churn-child"], timeout_s=1200.0)
     mixed = _run_child(["--mixed-child"], timeout_s=1200.0)
+    stream = _run_child(["--stream-child"], timeout_s=900.0)
     kernel = _run_child(["--kernel-child"])
+    kernel_100k = _run_child(["--kernel-100k-child"], timeout_s=900.0,
+                             allow_dnf=True)
 
     def med(trials, key):
         return _median([t[key] for t in trials])
@@ -304,7 +404,12 @@ def main() -> None:
             "BASELINE.md); the sim_ladder secondary is the same harness "
             "over direct function-call transport (socket costs removed); "
             "kernel_vs_scalar_loop is the kernel batching effect in "
-            "isolation" % (TRIALS, HEADLINE_GROUPS)),
+            "isolation; peer5_10240 is BASELINE config 3's true shape "
+            "(5-peer x 10240 groups) run end to end; over gRPC the scalar "
+            "cost shape cannot bring up >=512 groups at all (grpc_1024."
+            "scalar_dnf) - the batched/coalesced design is the difference "
+            "between running and not running at that scale"
+            % (TRIALS, HEADLINE_GROUPS)),
         "secondary": {
             "groups": HEADLINE_GROUPS,
             "trials": TRIALS,
@@ -316,6 +421,24 @@ def main() -> None:
             "spread_batched": _spread(headline_cps),
             "spread_scalar": _spread(scalar_cps),
             "scalar_mode_commits_per_sec": _median(scalar_cps),
+            "peer5_10240": {
+                "commits_per_sec": peer5["commits_per_sec"],
+                "p50_ms": peer5["p50_ms"],
+                "p99_ms": peer5["p99_ms"],
+                "bringup_s": peer5["election_convergence_s"],
+                "peers": 5,
+            },
+            "peer7_2048": {
+                "commits_per_sec": peer7["commits_per_sec"],
+                "p99_ms": peer7["p99_ms"],
+                "bringup_s": peer7["election_convergence_s"],
+                "peers": 7,
+            },
+            "mesh_10240": (
+                {"dnf": True} if mesh.get("dnf") else {
+                    "commits_per_sec": mesh["commits_per_sec"],
+                    "p99_ms": mesh["p99_ms"],
+                    "devices": 8}),
             "sim_ladder": {str(g): _median([t["commits_per_sec"] for t in r])
                            for g, r in sorted(ladder.items())},
             "sim_ladder_p99_ms": {
@@ -336,22 +459,37 @@ def main() -> None:
                 "p99_ms": churn["p99_ms"],
                 "transfers_ok": churn["transfers_ok"],
                 "transfers_failed": churn["transfers_failed"],
+                "transfer_failures": churn.get("transfer_failures", []),
             },
             "mixed_filestore_1024": {
                 "commits_per_sec": mixed["commits_per_sec"],
                 "streams_ok": mixed["streams_ok"],
+                "streams_failed": mixed.get("streams_failed", 0),
+                "stream_failures": mixed.get("stream_failures", []),
                 "stream_mb_per_s": mixed["stream_mb_per_s"],
             },
-            "grpc_256": {
+            "stream_throughput": {
+                "streams_ok": stream["streams_ok"],
+                "stream_mb_per_s": stream["stream_mb_per_s"],
+                "streams": stream["streams"],
+                "stream_mb": stream["stream_mb"],
+                "packet_kb": stream["packet_kb"],
+            },
+            "grpc_1024": {
                 "batched_commits_per_sec": _median(
                     [t["commits_per_sec"] for t in grpc_b]),
-                "scalar_commits_per_sec": _median(
-                    [t["commits_per_sec"] for t in grpc_s]),
                 "batched_p99_ms": _median([t["p99_ms"] for t in grpc_b]),
+                "scalar_dnf": bool(grpc_s_1024.get("dnf")),
+                "scalar_1024_commits_per_sec": grpc_s_1024.get(
+                    "commits_per_sec"),
+                "scalar_largest_completing": {
+                    "groups": 256,
+                    "commits_per_sec": grpc_s_256.get("commits_per_sec")},
             },
             "kernel_group_updates_per_sec": kernel["group_updates_per_sec"],
             "kernel_vs_scalar_loop": kernel["vs_scalar_loop"],
             "kernel_platform": kernel["platform"],
+            "kernel_100k": kernel_100k,
         },
     }))
 
@@ -365,5 +503,9 @@ if __name__ == "__main__":
         child_churn()
     elif len(sys.argv) > 1 and sys.argv[1] == "--mixed-child":
         child_mixed()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--stream-child":
+        child_stream()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--kernel-100k-child":
+        child_kernel_100k()
     else:
         main()

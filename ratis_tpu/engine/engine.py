@@ -161,6 +161,9 @@ class QuorumEngine:
         # A listener without the sync commit hook has an undelivered commit
         # riding the tick path; the sweep gate must not skip while set.
         self._tick_commit_pending = False
+        # largest compiled event bucket (lowered by prewarm): dispatch
+        # chunks never exceed it, so no fresh jit shape mid-run
+        self._event_bucket_cap = self._MAX_EVENT_BUCKET
         self.metrics = {"ticks": 0, "acks": 0, "commit_advances": 0,
                         "batched_dispatches": 0, "refresh_rows": 0,
                         "fast_ticks": 0, "refresh_ticks": 0, "idle_skips": 0}
@@ -663,6 +666,10 @@ class QuorumEngine:
         s = self.state
         now = self.clock.now_ms()
         saved_dirty = set(s.dirty)
+        # backlog chunking must stay inside what this call compiles — a
+        # bigger batch mid-run would be a fresh shape = a synchronous
+        # multi-second compile on the event loop
+        self._event_bucket_cap = max(self._bucket(ec) for ec in event_counts)
         for dc in group_counts:
             if dc > s.capacity:
                 continue
@@ -737,15 +744,17 @@ class QuorumEngine:
                 evp[6, k + i] = deadline
         return evp
 
-    # Largest prewarmed event bucket (64 * 4^4).  A backlog tick must NEVER
-    # exceed it: the next bucket would be a brand-new jit shape, and that
-    # compile (measured minutes on the CPU backend at E=65536) lands
+    # Hard ceiling on one dispatch's event bucket (64 * 4^4).  A backlog
+    # tick must NEVER exceed the largest COMPILED bucket: the next bucket
+    # would be a brand-new jit shape, and that compile (measured minutes
+    # on the CPU backend at E=65536, 12.9s at E=8192->16384) lands
     # synchronously on the event loop mid-run.  Oversized batches are
-    # processed as bounded-shape chunks instead.
+    # processed as bounded-shape chunks instead; prewarm() lowers the
+    # effective cap to the largest bucket it actually compiled.
     _MAX_EVENT_BUCKET = 16384
 
     def _tick_batched(self, acks, now: int) -> list[tuple[int, str, int]]:
-        cap = self._MAX_EVENT_BUCKET
+        cap = min(self._MAX_EVENT_BUCKET, self._event_bucket_cap)
         if len(acks) + len(self._slot_updates) <= cap:
             return self._tick_batched_pass(acks, now)
         # Pathological backlog (the loop was stalled long enough for >16k

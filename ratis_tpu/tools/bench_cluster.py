@@ -61,6 +61,11 @@ def bench_properties(batched: bool, num_groups: int = 1,
     # as density grows; both engine modes get the same setting, so the
     # batched/scalar comparison is unaffected.
     channels = num_groups * max(num_servers - 1, 1)
+    if channels >= 2048:
+        # the per-call rpc deadline scales with density too: at thousands
+        # of channels a legitimately-busy handler on a loaded loop blows a
+        # 3s deadline, and mass timeouts amplify into retry storms
+        p.set(RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_KEY, "8s")
     if channels >= 32768:
         RaftServerConfigKeys.Rpc.set_timeout(p, "16s", "32s")
     elif channels >= 16384:
@@ -310,10 +315,14 @@ class BenchCluster:
         server = self._leader_hint.get(gid, self.servers[0])
         deadline = time.monotonic() + timeout
         while True:
+            # bounded per-attempt deadline: one stuck call must cost one
+            # attempt, not the write's whole retry budget (the client
+            # transport's 30s default ate 2 of the 60s budget per hang)
             req = RaftClientRequest(client_id, server.peer_id, gid,
                                     next(self._call_ids),
                                     Message.value_of(message),
-                                    type=write_request_type())
+                                    type=write_request_type(),
+                                    timeout_ms=10_000.0)
             try:
                 reply = await client.send_request(server.address, req)
             except (RaftException, asyncio.TimeoutError):
@@ -500,7 +509,9 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
         churn_stats = {"ok": 0, "failed": 0}
 
         async def churn():
+            from ratis_tpu.protocol.exceptions import NotLeaderException
             client_id = ClientId.random_id()
+            by_id = {s.peer_id: s for s in cluster.servers}
             for _ in range(transfers):
                 g = rng.choice(cluster.groups)
                 leader_srv = cluster._leader_hint.get(g.group_id,
@@ -508,19 +519,45 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
                 target = rng.choice(
                     [p.id for p in g.peers if p.id != leader_srv.peer_id])
                 args = TransferLeadershipArguments(str(target), 3000.0)
-                req = RaftClientRequest(
-                    client_id, leader_srv.peer_id, g.group_id,
-                    next(cluster._call_ids), Message(args.to_payload()),
-                    type=admin_request_type(RequestType.TRANSFER_LEADERSHIP),
-                    timeout_ms=5000.0)
                 try:
-                    reply = await client.send_request(leader_srv.address, req)
-                    if reply.success:
+                    # an earlier transfer may have moved this group's
+                    # leadership: follow the NotLeader suggestion like any
+                    # real admin client (the reference's client retry
+                    # policy does exactly this) — bounded to the peer count
+                    reply = None
+                    for _attempt in range(len(g.peers)):
+                        req = RaftClientRequest(
+                            client_id, leader_srv.peer_id, g.group_id,
+                            next(cluster._call_ids),
+                            Message(args.to_payload()),
+                            type=admin_request_type(
+                                RequestType.TRANSFER_LEADERSHIP),
+                            timeout_ms=5000.0)
+                        reply = await client.send_request(
+                            leader_srv.address, req)
+                        exc = reply.exception
+                        if reply.success \
+                                or not isinstance(exc, NotLeaderException) \
+                                or exc.suggested_leader is None:
+                            break
+                        leader_srv = by_id.get(exc.suggested_leader.id,
+                                               leader_srv)
+                        # transferring "away from the leader" must track
+                        # the real leader, or we'd ask it to transfer to
+                        # itself
+                        if target == leader_srv.peer_id:
+                            target = rng.choice(
+                                [p.id for p in g.peers
+                                 if p.id != leader_srv.peer_id])
+                            args = TransferLeadershipArguments(
+                                str(target), 3000.0)
+                    if reply is not None and reply.success:
                         churn_stats["ok"] += 1
-                        cluster._leader_hint.pop(g.group_id, None)
+                        cluster._leader_hint[g.group_id] = by_id.get(
+                            target, cluster.servers[0])
                     else:
                         churn_stats["failed"] += 1
-                        exc = reply.exception
+                        exc = reply.exception if reply is not None else None
                         churn_stats.setdefault("failures", []).append(
                             type(exc).__name__ if exc else "no-exception")
                         print(f"bench: transfer {g.group_id} -> {target} "

@@ -44,6 +44,7 @@ CLIENT_SERVICE = "ratis_tpu.RaftClientProtocol"
 _RPC_METHOD = f"/{SERVER_SERVICE}/rpc"
 _APPEND_STREAM_METHOD = f"/{SERVER_SERVICE}/appendStream"
 _REQUEST_METHOD = f"/{CLIENT_SERVICE}/request"
+_REQUEST_STREAM_METHOD = f"/{CLIENT_SERVICE}/requestStream"
 
 # append-stream envelope status codes
 _ST_OK = 0
@@ -213,10 +214,13 @@ class _AppendStreamClient:
         self._next_id += 1
         fut = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
+        wrote = False
 
         async def _write_then_wait() -> bytes:
+            nonlocal wrote
             async with self._write_lock:
                 await self._call.write(msgpack.packb([call_id, payload]))
+            wrote = True
             return await fut
 
         try:
@@ -224,6 +228,16 @@ class _AppendStreamClient:
             # (frozen peer, full HTTP/2 window) must also time out so the
             # appender's send slot frees and its window resets
             return await asyncio.wait_for(_write_then_wait(), timeout_s)
+        except asyncio.TimeoutError:
+            if not wrote:
+                # the deadline cancelled the writer MID self._call.write():
+                # the call may hold an abandoned core write op, and reusing
+                # it breaks the overlapping-write serialization — this
+                # stream is done (callers see .closed and re-dial); only
+                # the reply-is-late case is safe to ride out
+                self._fail(TimeoutIOException(
+                    "append stream write timed out (flow-blocked peer)"))
+            raise
         finally:
             self._pending.pop(call_id, None)
 
@@ -335,16 +349,17 @@ class GrpcServerTransport(ServerTransport):
     # handler tasks)
     _STREAM_CONCURRENCY = 256
 
-    async def _handle_append_stream(self, request_iterator, context):
-        """Server side of the per-peer append stream
-        (GrpcServerProtocolService.java:46 appendEntries stream observer).
-        Chunks are handled CONCURRENTLY (a slow division flush must not
-        head-of-line-block every co-hosted group riding the same stream —
-        the same policy as the TCP transport's per-frame tasks) and replies
-        carry the chunk's stream-local id, so they may complete out of
-        order.  Per-group FIFO still holds: handler tasks are created in
-        arrival order and asyncio schedules/queues them (and the division
-        append lock) in that order."""
+    async def _serve_stream(self, request_iterator, dispatch):
+        """Shared server scaffold for the multiplexed bidi streams (append
+        plane and client plane): chunks are handled CONCURRENTLY (a slow
+        division flush must not head-of-line-block every co-hosted group
+        riding the same stream — the same policy as the TCP transport's
+        per-frame tasks) and replies carry the chunk's stream-local id, so
+        they may complete out of order.  Per-group FIFO still holds:
+        handler tasks are created in arrival order and asyncio
+        schedules/queues them (and the division append lock) in that
+        order.  ``dispatch(payload) -> reply bytes``; a RaftException maps
+        to _ST_RAFT_ERROR, anything else to _ST_INTERNAL."""
         replies: asyncio.Queue = asyncio.Queue()
         gate = asyncio.Semaphore(self._STREAM_CONCURRENCY)
         tasks: set[asyncio.Task] = set()
@@ -352,16 +367,13 @@ class GrpcServerTransport(ServerTransport):
         async def run_one(call_id: int, payload: bytes) -> None:
             try:
                 try:
-                    msg = decode_rpc(payload)
-                    reply = await self.server_handler(msg)
-                    out = [call_id, _ST_OK, encode_rpc(reply)]
+                    out = [call_id, _ST_OK, await dispatch(payload)]
                 except RaftException as e:
                     out = [call_id, _ST_RAFT_ERROR, str(e).encode()]
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
-                    LOG.exception("%s: append stream rpc failed",
-                                  self.peer_id)
+                    LOG.exception("%s: stream rpc failed", self.peer_id)
                     out = [call_id, _ST_INTERNAL, str(e).encode()]
                 replies.put_nowait(msgpack.packb(out))
             finally:
@@ -378,8 +390,8 @@ class GrpcServerTransport(ServerTransport):
                         # (the old unary abort carried the reason; a bare
                         # break would leave both ends diagnosing a generic
                         # 'stream closed').
-                        LOG.error("%s: undecodable append-stream chunk "
-                                  "(%s); closing stream", self.peer_id, e)
+                        LOG.error("%s: undecodable stream chunk (%s); "
+                                  "closing stream", self.peer_id, e)
                         break
                     await gate.acquire()
                     t = asyncio.create_task(run_one(call_id, payload))
@@ -406,11 +418,38 @@ class GrpcServerTransport(ServerTransport):
             for t in list(tasks):
                 t.cancel()
 
+    async def _handle_append_stream(self, request_iterator, context):
+        """Server side of the per-peer append stream
+        (GrpcServerProtocolService.java:46 appendEntries stream observer)."""
+
+        async def dispatch(payload: bytes) -> bytes:
+            return encode_rpc(await self.server_handler(decode_rpc(payload)))
+
+        async for item in self._serve_stream(request_iterator, dispatch):
+            yield item
+
+    async def _handle_client_stream(self, request_iterator, context):
+        """Server side of the multiplexed client-request stream (reference
+        GrpcClientProtocolService.java ordered stream): same id-matched
+        concurrent-chunk shape as the append stream — one HTTP/2 stream per
+        (client, server) instead of one per request, which is where
+        grpc.aio's per-unary-call overhead was going at 1024 groups."""
+
+        async def dispatch(payload: bytes) -> bytes:
+            request = RaftClientRequest.from_bytes(payload)
+            return (await self.client_handler(request)).to_bytes()
+
+        async for item in self._serve_stream(request_iterator, dispatch):
+            yield item
+
     def _client_handlers(self):
         return grpc.method_handlers_generic_handler(
             CLIENT_SERVICE,
             {"request": grpc.unary_unary_rpc_method_handler(
                 self._handle_client, request_deserializer=_identity,
+                response_serializer=_identity),
+             "requestStream": grpc.stream_stream_rpc_method_handler(
+                self._handle_client_stream, request_deserializer=_identity,
                 response_serializer=_identity)})
 
     async def _handle_admin(self, request_bytes: bytes, context) -> bytes:
@@ -597,9 +636,25 @@ class GrpcServerTransport(ServerTransport):
                                             self.request_timeout_s)
         except (RaftException, TimeoutIOException):
             raise
-        except (asyncio.TimeoutError, Exception) as e:
-            # broken/stalled stream: drop it so the next send re-dials, and
-            # surface as transient so the appender resets its window
+        except asyncio.TimeoutError:
+            # ONE call's deadline elapsed on an otherwise-live stream (busy
+            # peer / loaded loop).  Do NOT tear the stream down: it is
+            # shared by every in-flight append to this peer, and killing it
+            # fails them ALL — measured at 1024 gRPC groups, that turned
+            # one slow reply into a redial storm that collapsed bring-up.
+            # The reader simply drops the late reply when it arrives.
+            # Exception: a MID-WRITE timeout already failed the stream
+            # (abandoned core write op — unsafe to reuse); drop it.
+            if stream.closed:
+                self._append_streams.pop(address, None)
+                await stream.close()
+            raise TimeoutIOException(
+                f"{self.peer_id}->{to} append stream call timed out"
+            ) from None
+        except Exception as e:
+            # stream-level failure (write error, reader death): drop it so
+            # the next send re-dials, surface as transient so the appender
+            # resets its window
             self._append_streams.pop(address, None)
             await stream.close()
             raise TimeoutIOException(
@@ -619,12 +674,53 @@ class GrpcClientTransport(ClientTransport):
                  tls: Optional[GrpcTlsConfig] = None):
         self._pool = _ChannelPool(tls)
         self.request_timeout_s = request_timeout_s
+        # address -> shared bidi request stream (one per server)
+        self._streams: dict[str, _AppendStreamClient] = {}
 
     async def send_request(self, peer_address: str,
                            request: RaftClientRequest) -> RaftClientReply:
-        call = self._pool.unary(peer_address, _REQUEST_METHOD)
+        """Requests ride one long-lived bidi stream per server (reference
+        GrpcClientProtocolService's ordered stream): the per-unary-call
+        setup that dominated client-plane cost at high request rates is
+        paid once per (client, server) instead of once per request."""
         timeout = (request.timeout_ms / 1000.0 if request.timeout_ms > 0
                    else self.request_timeout_s)
+        from ratis_tpu.protocol.requests import RequestType
+        if request.type.type >= RequestType.SET_CONFIGURATION:
+            # admin block stays unary: the dedicated admin endpoint serves
+            # only the unary method (its filter aborts with grpc status
+            # codes), and admin calls are low-rate anyway
+            return await self._send_unary(peer_address, request, timeout)
+        stream = self._streams.get(peer_address)
+        if stream is None or stream.closed:
+            stream = _AppendStreamClient(
+                lambda: self._pool.stream(peer_address,
+                                          _REQUEST_STREAM_METHOD)())
+            self._streams[peer_address] = stream
+        try:
+            reply_bytes = await stream.send(request.to_bytes(), timeout)
+        except (RaftException, TimeoutIOException):
+            raise
+        except asyncio.TimeoutError:
+            # per-call deadline on a live stream: fail THIS call only (the
+            # stream carries every other in-flight request to this server);
+            # a mid-write timeout already failed the stream — drop it
+            if stream.closed:
+                self._streams.pop(peer_address, None)
+                await stream.close()
+            raise TimeoutIOException(
+                f"client->{peer_address} request timed out") from None
+        except Exception as e:
+            self._streams.pop(peer_address, None)
+            await stream.close()
+            raise TimeoutIOException(
+                f"client->{peer_address} request stream: {e}") from None
+        return RaftClientReply.from_bytes(reply_bytes)
+
+    async def _send_unary(self, peer_address: str,
+                          request: RaftClientRequest,
+                          timeout: float) -> RaftClientReply:
+        call = self._pool.unary(peer_address, _REQUEST_METHOD)
         try:
             reply_bytes = await call(request.to_bytes(), timeout=timeout)
         except grpc.aio.AioRpcError as e:
@@ -638,6 +734,9 @@ class GrpcClientTransport(ClientTransport):
         return RaftClientReply.from_bytes(reply_bytes)
 
     async def close(self) -> None:
+        for stream in list(self._streams.values()):
+            await stream.close()
+        self._streams.clear()
         await self._pool.close()
 
 
