@@ -247,10 +247,12 @@ def _run_child(args: list[str], timeout_s: float = 900.0,
                allow_dnf: bool = False) -> dict:
     t0 = time.monotonic()
     print(f"bench: running {args} ...", file=sys.stderr, flush=True)
+    env = dict(os.environ)
+    env.setdefault("RATIS_BENCH_GCLOG", "1")  # pause attribution in stderr
     try:
         proc = subprocess.run(
             [sys.executable, __file__] + args, capture_output=True,
-            text=True, timeout=timeout_s,
+            text=True, timeout=timeout_s, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         if allow_dnf:
@@ -293,8 +295,21 @@ def _spread(xs: list[float]) -> float:
 
 def _run_trials(spec: str, n: int,
                 timeout_s: float = 900.0) -> list[dict]:
-    return [_run_child(["--e2e-child", spec], timeout_s=timeout_s)
-            for _ in range(n)]
+    """Run n trials; AT MOST ONE flaky trial (timeout / stuck child) is
+    dropped from the median rather than aborting the whole multi-rung
+    bench — two failures is a broken rung, not a tail event."""
+    out = []
+    dnf = 0
+    for _ in range(n):
+        r = _run_child(["--e2e-child", spec], timeout_s=timeout_s,
+                       allow_dnf=True)
+        if r.get("dnf"):
+            dnf += 1
+        else:
+            out.append(r)
+    if dnf > 1 or not out:
+        raise RuntimeError(f"{dnf}/{n} trials of {spec} failed")
+    return out
 
 
 def main() -> None:
@@ -420,6 +435,14 @@ def main() -> None:
                                           "election_convergence_s"),
             "spread_batched": _spread(headline_cps),
             "spread_scalar": _spread(scalar_cps),
+            "write_failures_total": sum(
+                t.get("write_failures", 0)
+                for r in (headline, scalar, grpc_b, *ladder.values())
+                for t in r) + sum(
+                t.get("write_failures", 0)
+                for t in (peer5, peer7, mesh, grpc_s_1024, grpc_s_256,
+                          sparse_hib, sparse_plain, churn, mixed)
+                if isinstance(t, dict)),
             "scalar_mode_commits_per_sec": _median(scalar_cps),
             "peer5_10240": {
                 "commits_per_sec": peer5["commits_per_sec"],

@@ -97,12 +97,13 @@ def bench_properties(batched: bool, num_groups: int = 1,
     # the harness calls seal_heap() right after bring-up instead of waiting
     # out the idle window)
     p.set(RaftServerConfigKeys.Gc.DISCIPLINE_KEY, "true")
-    if channels >= 16384:
-        # steady-state re-freeze: the in-memory logs accrete live entries
-        # under load and young-gen passes were measured burning 0.3-0.5s
-        # each collecting ZERO at this density (memory log never purges,
-        # so the refreeze leak trade is moot here)
-        p.set(RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_KEY, "20s")
+    # steady-state re-freeze on every rung: the in-memory logs accrete
+    # live entries under load and collector passes over them were
+    # measured at 0.3-0.5s (gen1, 40k channels) up to 13.8s (gen2 over a
+    # retry-storm-bloated young heap at 1024 gRPC groups) — collecting
+    # ZERO every time.  The memory log never purges, so the refreeze
+    # leak trade is moot here.
+    p.set(RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_KEY, "15s")
     if mesh_devices:
         # shard the resident engine state over the group axis of an
         # n-device mesh (parallel/mesh.py; the rung that gives sharding a
@@ -362,6 +363,7 @@ class BenchCluster:
 
         import os
         trace = os.environ.get("RATIS_BENCH_TRACE")
+        failures: list[str] = []
 
         async def group_load(g: RaftGroup):
             client_id = ClientId.random_id()
@@ -370,8 +372,18 @@ class BenchCluster:
                     msg = (message_factory() if message_factory is not None
                            else b"INCREMENT")
                     t0 = time.monotonic()
-                    await self._write(client, client_id, g.group_id,
-                                      message=msg)
+                    try:
+                        await self._write(client, client_id, g.group_id,
+                                          message=msg)
+                    except TimeoutError as e:
+                        # ONE write exhausting its retry budget must be
+                        # REPORTED, not abort a multi-thousand-write rung
+                        # (observed ~1/20k over grpc under load); the rung
+                        # still fails loudly past a 1% fraction below
+                        failures.append(str(g.group_id))
+                        print(f"bench: WRITE FAILED {g.group_id}: {e}",
+                              file=sys.stderr, flush=True)
+                        continue
                     latencies.append(time.monotonic() - t0)
                     if trace and len(latencies) % 4096 == 0:
                         print(f"bench: {len(latencies)} writes done "
@@ -382,13 +394,18 @@ class BenchCluster:
         await asyncio.gather(*(group_load(g) for g in target_groups))
         elapsed = time.monotonic() - t_start
 
+        total = len(target_groups) * writes_per_group
+        if not latencies or len(failures) > max(8, total // 100):
+            raise TimeoutError(
+                f"{len(failures)}/{total} writes failed — not a tail "
+                f"event, the rung is broken: {failures[:5]}")
         latencies.sort()
         n = len(latencies)
-        total = len(target_groups) * writes_per_group
         return {
-            "commits": total,
+            "commits": total - len(failures),
+            "write_failures": len(failures),
             "elapsed_s": round(elapsed, 3),
-            "commits_per_sec": round(total / elapsed, 1),
+            "commits_per_sec": round((total - len(failures)) / elapsed, 1),
             "p50_ms": round(latencies[n // 2] * 1e3, 2),
             "p99_ms": round(latencies[min(n - 1, (n * 99) // 100)] * 1e3, 2),
             "election_convergence_s": round(self.election_convergence_s, 2),
@@ -509,7 +526,6 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
         churn_stats = {"ok": 0, "failed": 0}
 
         async def churn():
-            from ratis_tpu.protocol.exceptions import NotLeaderException
             client_id = ClientId.random_id()
             by_id = {s.peer_id: s for s in cluster.servers}
             for _ in range(transfers):
@@ -525,7 +541,7 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
                     # real admin client (the reference's client retry
                     # policy does exactly this) — bounded to the peer count
                     reply = None
-                    for _attempt in range(len(g.peers)):
+                    for _attempt in range(2 * len(g.peers)):
                         req = RaftClientRequest(
                             client_id, leader_srv.peer_id, g.group_id,
                             next(cluster._call_ids),
@@ -536,8 +552,15 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
                         reply = await client.send_request(
                             leader_srv.address, req)
                         exc = reply.exception
-                        if reply.success \
-                                or not isinstance(exc, NotLeaderException) \
+                        if reply.success:
+                            break
+                        if isinstance(exc, LeaderNotReadyException):
+                            # transfer raced a just-won election: the new
+                            # leader serves admin ops once its startup
+                            # entry commits — moments away
+                            await asyncio.sleep(0.1)
+                            continue
+                        if not isinstance(exc, NotLeaderException) \
                                 or exc.suggested_leader is None:
                             break
                         leader_srv = by_id.get(exc.suggested_leader.id,

@@ -126,6 +126,15 @@ class GrpcTlsConfig:
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", 256 * 1024 * 1024),
     ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    # A multi-raft host's event loop legitimately stalls for seconds
+    # (deliberate GC seal, cold jit compile); default HTTP/2 ping/settings
+    # deadlines then GOAWAY every connection at once, and the mass
+    # reconnect allocates so much that the NEXT collector pass is even
+    # longer — a measured death spiral at 1024 co-hosted groups.  Be
+    # generous: consensus liveness has its own (election) timers.
+    ("grpc.keepalive_timeout_ms", 60_000),
+    ("grpc.http2.ping_timeout_ms", 60_000),
+    ("grpc.http2.settings_timeout", 60_000),
 ]
 
 _identity = lambda b: b  # noqa: E731  (bytes in/out; codecs are ours)
