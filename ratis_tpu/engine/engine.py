@@ -518,6 +518,23 @@ class QuorumEngine:
             # engine's dispatch rate drops from per-tick to per-sweep.
             self.metrics["idle_skips"] += 1
             return
+        if use_batched:
+            # why did the gate let this dispatch through? (the dispatch
+            # count at scale is THE batched-mode cost driver; this makes
+            # its composition observable instead of guessed at)
+            m = self.metrics
+            if self._dev is None:
+                m["dispatch_upload"] = m.get("dispatch_upload", 0) + 1
+            elif self._tick_commit_pending:
+                m["dispatch_commit"] = m.get("dispatch_commit", 0) + 1
+            elif s.dirty:
+                m["dispatch_dirty"] = m.get("dispatch_dirty", 0) + 1
+            elif self._vote_rounds or self._vote_ring:
+                m["dispatch_votes"] = m.get("dispatch_votes", 0) + 1
+            elif now >= self._next_sweep_ms:
+                m["dispatch_sweep"] = m.get("dispatch_sweep", 0) + 1
+            else:
+                m["dispatch_backlog"] = m.get("dispatch_backlog", 0) + 1
 
         acks = self._ack_ring
         self._ack_ring = []

@@ -446,10 +446,30 @@ class LogAppender:
             # follower slowness would spam notifications for silence the
             # leader itself requested
             div.check_follower_slowness(f)
-        if now - self._last_send_s < self.heartbeat_interval_s * 0.9:
+        # Due-ness keys on CONFIRMED contact (the follower's replies), not
+        # on queueing: a data batch stamps _last_send_s when it enters an
+        # envelope, and under congestion that envelope can sit queued (or
+        # time out) while the follower hears silence past its election
+        # timeout — measured at 5-peer x 10240 bring-up, thousands of
+        # healthy leaders were deposed by followers whose p50 silence was
+        # 17.8s.  Policy for a follower that stops replying: up to TWO
+        # heartbeat attempts per interval (the 0.45*hb send cap), so an
+        # unresponsive peer costs at most 2x the idle item volume.
+        # _last_send_s == 0.0 is the explicit force-due marker (hibernation
+        # wake sets it: "next sweep heartbeats immediately").
+        hb = self.heartbeat_interval_s
+        if self._last_send_s:
+            if now - f.last_rpc_response_s < hb * 0.9:
+                return None  # follower demonstrably fresh (recent reply)
+            if now - self._last_send_s < hb * 0.45:
+                return None  # give the in-flight contact a chance to land
+        if f.snapshot_in_progress:
             return None
-        if now < self._backoff_until or f.snapshot_in_progress:
-            return None
+        # NB: _backoff_until deliberately does NOT suppress the compact
+        # heartbeat — the data window pauses on send errors, but this is
+        # exactly the contact that must keep flowing while it does (the
+        # reference's separate heartbeat channel has the same property,
+        # GrpcLogAppender heartbeat channel).
         log = div.state.log
         commit = log.get_last_committed_index()
         self._last_send_s = now
@@ -575,10 +595,17 @@ class LogAppender:
         self.sender.mark(self)  # periodic fill retry (backoff expiry etc.)
         try:
             div.check_follower_slowness(self.follower)
-            if now - self._last_send_s < self.heartbeat_interval_s * 0.9:
-                return  # recent traffic doubles as a heartbeat
-            if now < self._backoff_until:
-                return
+            # same confirmed-contact due-ness as heartbeat_item: a QUEUED
+            # (or erroring, backed-off) data batch must not suppress the
+            # dedicated heartbeat while the follower hears silence — the
+            # deposal mechanism was identical on this path
+            f = self.follower
+            interval = self.heartbeat_interval_s
+            if self._last_send_s:
+                if now - f.last_rpc_response_s < interval * 0.9:
+                    return  # follower demonstrably fresh (recent reply)
+                if now - self._last_send_s < interval * 0.45:
+                    return
             hb = self._build_request(self.follower.next_index,
                                      heartbeat=True)
             if hb is None:

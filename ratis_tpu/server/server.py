@@ -122,9 +122,15 @@ class HeartbeatScheduler:
                                 b[1].append(appender)
                         else:
                             appender.on_heartbeat_sweep(now)
-                        if sweep % 256 == 0:
-                            # don't stall the loop for one giant synchronous
-                            # burst at thousands of co-hosted leaders
+                        if sweep % 1024 == 0:
+                            # Yield so the sweep never stalls the loop for
+                            # one giant synchronous burst — but COARSELY: on
+                            # a saturated loop every yield waits out the
+                            # whole ready backlog, and at 40960 items a
+                            # per-256 cadence stretched the sweep past the
+                            # election timeout (followers of healthy
+                            # leaders heard 16s+ of silence and deposed
+                            # them).  1024 items ≈ tens of ms per stretch.
                             await asyncio.sleep(0)
                 except asyncio.CancelledError:
                     raise
@@ -614,7 +620,10 @@ class RaftServer:
                 except Exception:
                     LOG.exception("%s bulk heartbeat item failed",
                                   self.peer_id)
-            if (n + 1) % 256 == 0:
+            if (n + 1) % 1024 == 0:
+                # coarse yield cadence, same rationale as the sweep's: on a
+                # loaded loop each yield waits out the ready backlog, and
+                # heartbeat DELIVERY latency is an election-liveness input
                 await asyncio.sleep(0)
         return BulkHeartbeatReply(tuple(results))
 

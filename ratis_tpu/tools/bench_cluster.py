@@ -67,7 +67,10 @@ def bench_properties(batched: bool, num_groups: int = 1,
         # 3s deadline, and mass timeouts amplify into retry storms
         p.set(RaftServerConfigKeys.Rpc.REQUEST_TIMEOUT_KEY, "8s")
     if channels >= 32768:
-        RaftServerConfigKeys.Rpc.set_timeout(p, "16s", "32s")
+        # margin over the sweep period matters as much as volume here: a
+        # loaded sweep delivers late, and the election timeout must
+        # tolerate a couple of late sweeps without deposing the leader
+        RaftServerConfigKeys.Rpc.set_timeout(p, "24s", "48s")
     elif channels >= 16384:
         RaftServerConfigKeys.Rpc.set_timeout(p, "8s", "16s")
     elif channels >= 4096:
@@ -489,6 +492,12 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["batched_dispatches"] = sum(
             e.metrics["batched_dispatches"] for e in engines)
         result["engine_ticks"] = sum(e.metrics["ticks"] for e in engines)
+        for reason in ("dispatch_upload", "dispatch_commit",
+                       "dispatch_dirty", "dispatch_votes",
+                       "dispatch_sweep", "dispatch_backlog"):
+            v = sum(e.metrics.get(reason, 0) for e in engines)
+            if v:
+                result[reason] = v
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport

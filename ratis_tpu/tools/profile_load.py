@@ -1,5 +1,7 @@
 """Dev tool: cProfile the bench load phase at N groups (not part of the
-framework; run as `python tools_profile_load.py [groups] [batched]`)."""
+framework; run as
+`python -m ratis_tpu.tools.profile_load [groups] [batched|scalar] [writes]
+ [transport] [peers]`)."""
 import asyncio
 import cProfile
 import io
@@ -24,10 +26,12 @@ def main():
     writes = int(sys.argv[3]) if len(sys.argv) > 3 else 8
     batched = (sys.argv[2] != "scalar") if len(sys.argv) > 2 else True
     transport = sys.argv[4] if len(sys.argv) > 4 else "sim"
+    peers = int(sys.argv[5]) if len(sys.argv) > 5 else 3
     from ratis_tpu.tools.bench_cluster import BenchCluster
 
     async def run():
-        cluster = BenchCluster(groups, batched=batched, transport=transport)
+        cluster = BenchCluster(groups, batched=batched, transport=transport,
+                               num_servers=peers)
         try:
             await cluster.start()
             await cluster.run_load(1, 128)  # warmup
