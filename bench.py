@@ -73,10 +73,60 @@ def _gc_log() -> None:
     gc.callbacks.append(cb)
 
 
+def _mem_log() -> None:
+    """RATIS_BENCH_MEMLOG=1: every 10s, log RSS and the top Python object
+    populations (diagnoses which population a runaway heap is)."""
+    import collections
+    import gc
+    import threading
+
+    def sample() -> None:
+        last_rss = 0
+        while True:
+            time.sleep(10)
+            with open("/proc/self/status") as f:
+                rss = [l for l in f if l.startswith("VmRSS")][0].strip()
+            rss_kb = int(rss.split()[1])
+            if rss_kb - last_rss < 400_000:
+                # the full-object walk below holds the GIL for seconds on
+                # the very heaps it diagnoses — only pay it while the heap
+                # is actually ballooning
+                print(f"bench: MEM {rss}", file=sys.stderr, flush=True)
+                continue
+            last_rss = rss_kb
+            objs = gc.get_objects()
+            counts = collections.Counter(type(o).__name__ for o in objs)
+            print(f"bench: MEM {rss} top={counts.most_common(8)}",
+                  file=sys.stderr, flush=True)
+            # who HOLDS the dominant grpc op objects? walk referrers of one
+            # name the live tasks/coroutines: a drowned loop shows up as
+            # thousands of one kind
+            tasks = collections.Counter()
+            coros = collections.Counter()
+            for o in objs:
+                tn = type(o).__name__
+                try:
+                    if tn == "Task":
+                        tasks[o.get_coro().__qualname__] += 1
+                    elif tn == "coroutine":
+                        coros[o.__qualname__] += 1
+                except Exception:
+                    pass
+            print(f"bench: MEMTASKS {tasks.most_common(5)}",
+                  file=sys.stderr, flush=True)
+            print(f"bench: MEMCOROS {coros.most_common(5)}",
+                  file=sys.stderr, flush=True)
+            del objs
+
+    threading.Thread(target=sample, daemon=True).start()
+
+
 def child_e2e(spec: str) -> None:
     cfg = json.loads(spec)
     if os.environ.get("RATIS_BENCH_GCLOG"):
         _gc_log()
+    if os.environ.get("RATIS_BENCH_MEMLOG"):
+        _mem_log()
     mesh = cfg.get("mesh", 0)
     if mesh:
         # must land before any jax backend init: the sharded resident

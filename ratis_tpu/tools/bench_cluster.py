@@ -48,7 +48,8 @@ from ratis_tpu.transport.simulated import (SimulatedNetwork,
 def bench_properties(batched: bool, num_groups: int = 1,
                      hibernate: bool = False,
                      mesh_devices: int = 0,
-                     num_servers: int = 3) -> RaftProperties:
+                     num_servers: int = 3,
+                     transport: str = "sim") -> RaftProperties:
     from ratis_tpu.engine.engine import QuorumEngine
     p = RaftProperties()
     # Timeouts scale with CHANNEL density (groups x followers): background
@@ -73,7 +74,14 @@ def bench_properties(batched: bool, num_groups: int = 1,
         RaftServerConfigKeys.Rpc.set_timeout(p, "24s", "48s")
     elif channels >= 16384:
         RaftServerConfigKeys.Rpc.set_timeout(p, "8s", "16s")
-    elif channels >= 4096:
+    elif channels >= (2048 if transport == "grpc" else 4096):
+        # 2048 channels at 1s/2s was metastable through the costlier
+        # grpc.aio transport: one hiccup tipped ~3000 divisions into
+        # concurrent elections (measured: 3072 live candidacies, 4k
+        # in-flight vote RPCs, multi-GB of pending call objects) and the
+        # storm sustained itself.  One tier of margin removes the basin —
+        # a deployment tunes this knob to its transport's per-op cost
+        # (TCP's cheap framing holds 1s/2s at the same density).
         RaftServerConfigKeys.Rpc.set_timeout(p, "4s", "8s")
     else:
         # 1s/2s at <=1024 3-peer groups: already ~7x the reference's
@@ -183,7 +191,8 @@ class BenchCluster:
         self.properties = bench_properties(batched, num_groups,
                                            hibernate=hibernate,
                                            mesh_devices=mesh_devices,
-                                           num_servers=num_servers)
+                                           num_servers=num_servers,
+                                           transport=transport)
         if self.network is not None:
             # the sim's default 3s rpc deadline models a small cluster; a
             # legitimately-busy handler at thousands of co-hosted groups

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import pathlib
+import time
 from typing import Callable, Optional
 
 import grpc
@@ -198,6 +199,28 @@ class _ChannelPool:
         self._channels.clear()
 
 
+class _StreamDialGate:
+    """Per-address re-dial pacing for the shared bidi streams.  Without
+    it, every pending send re-dials the instant a stream dies, and a
+    transient stall (loop pause, peer GOAWAY) becomes a dial storm:
+    thousands of grpc calls created per second, each leaving C-core
+    operation objects behind — measured as multi-GB RSS growth and a
+    drowned event loop.  One dial attempt per address per window; other
+    senders fail fast as transient and retry through their normal paths."""
+
+    WINDOW_S = 0.25
+
+    def __init__(self):
+        self._last: dict[str, float] = {}
+
+    def may_dial(self, address: str) -> bool:
+        now = time.monotonic()
+        if now - self._last.get(address, 0.0) < self.WINDOW_S:
+            return False
+        self._last[address] = now
+        return True
+
+
 class _AppendStreamClient:
     """One ordered bidi stream to a peer carrying entry-bearing
     AppendEntries (reference GrpcLogAppender's appendEntries stream,
@@ -289,6 +312,15 @@ class _AppendStreamClient:
             await self._reader
         except (asyncio.CancelledError, Exception):
             pass
+        try:
+            # release the C-core call deterministically: a merely-abandoned
+            # call keeps its operation objects (SendInitialMetadata /
+            # ReceiveStatus / CallbackWrapper) alive until a GC pass, and a
+            # re-dial storm accumulated tens of thousands of them (multi-GB
+            # RSS measured)
+            self._call.cancel()
+        except Exception:
+            pass
 
 
 class GrpcServerTransport(ServerTransport):
@@ -325,6 +357,7 @@ class GrpcServerTransport(ServerTransport):
         self._server: Optional[grpc.aio.Server] = None
         self._pool = _ChannelPool(tls)
         self._append_streams: dict[str, _AppendStreamClient] = {}
+        self._dial_gate = _StreamDialGate()
 
     # ---------------------------------------------------------- service side
 
@@ -369,7 +402,14 @@ class GrpcServerTransport(ServerTransport):
         schedules/queues them (and the division append lock) in that
         order.  ``dispatch(payload) -> reply bytes``; a RaftException maps
         to _ST_RAFT_ERROR, anything else to _ST_INTERNAL."""
-        replies: asyncio.Queue = asyncio.Queue()
+        # BOUNDED reply queue: run_one blocks on put when the consumer (the
+        # HTTP/2 send side) stalls, which keeps the gate held, which stops
+        # the pump from accepting more chunks — end-to-end backpressure.
+        # With an unbounded queue + release-on-enqueue, a peer that kept
+        # writing while its read side lagged ballooned this server's heap
+        # by the full reply backlog (measured: multi-GB RSS growth).
+        replies: asyncio.Queue = asyncio.Queue(
+            maxsize=self._STREAM_CONCURRENCY * 2)
         gate = asyncio.Semaphore(self._STREAM_CONCURRENCY)
         tasks: set[asyncio.Task] = set()
 
@@ -384,7 +424,7 @@ class GrpcServerTransport(ServerTransport):
                 except Exception as e:
                     LOG.exception("%s: stream rpc failed", self.peer_id)
                     out = [call_id, _ST_INTERNAL, str(e).encode()]
-                replies.put_nowait(msgpack.packb(out))
+                await replies.put(msgpack.packb(out))
             finally:
                 gate.release()
 
@@ -413,7 +453,13 @@ class GrpcServerTransport(ServerTransport):
                         await t
                     except (asyncio.CancelledError, Exception):
                         pass
-                replies.put_nowait(None)
+                # bounded: if the consumer is gone AND the queue is full
+                # (stalled peer disconnect), an unbounded put would leak
+                # this task + the reply backlog forever
+                try:
+                    await asyncio.wait_for(replies.put(None), 30.0)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    pass
 
         pump_task = asyncio.create_task(pump())
         try:
@@ -637,6 +683,13 @@ class GrpcServerTransport(ServerTransport):
     async def _send_via_stream(self, to: RaftPeerId, address: str, msg):
         stream = self._append_streams.get(address)
         if stream is None or stream.closed:
+            if not self._dial_gate.may_dial(address):
+                raise TimeoutIOException(
+                    f"{self.peer_id}->{to} append stream re-dial pacing")
+            if stream is not None:
+                # release the dead stream's C-core call before replacing it
+                # (it may have failed via _fail without anyone closing it)
+                await stream.close()
             stream = _AppendStreamClient(
                 lambda: self._pool.stream(address, _APPEND_STREAM_METHOD)())
             self._append_streams[address] = stream
@@ -655,7 +708,11 @@ class GrpcServerTransport(ServerTransport):
             # Exception: a MID-WRITE timeout already failed the stream
             # (abandoned core write op — unsafe to reuse); drop it.
             if stream.closed:
-                self._append_streams.pop(address, None)
+                if self._append_streams.get(address) is stream:
+                    # guarded: a concurrent sender may have re-dialed a
+                    # HEALTHY replacement — evicting that would orphan its
+                    # call un-cancelled
+                    self._append_streams.pop(address, None)
                 await stream.close()
             raise TimeoutIOException(
                 f"{self.peer_id}->{to} append stream call timed out"
@@ -664,7 +721,8 @@ class GrpcServerTransport(ServerTransport):
             # stream-level failure (write error, reader death): drop it so
             # the next send re-dials, surface as transient so the appender
             # resets its window
-            self._append_streams.pop(address, None)
+            if self._append_streams.get(address) is stream:
+                self._append_streams.pop(address, None)
             await stream.close()
             raise TimeoutIOException(
                 f"{self.peer_id}->{to} append stream: {e}") from None
@@ -685,6 +743,7 @@ class GrpcClientTransport(ClientTransport):
         self.request_timeout_s = request_timeout_s
         # address -> shared bidi request stream (one per server)
         self._streams: dict[str, _AppendStreamClient] = {}
+        self._dial_gate = _StreamDialGate()
 
     async def send_request(self, peer_address: str,
                            request: RaftClientRequest) -> RaftClientReply:
@@ -702,6 +761,11 @@ class GrpcClientTransport(ClientTransport):
             return await self._send_unary(peer_address, request, timeout)
         stream = self._streams.get(peer_address)
         if stream is None or stream.closed:
+            if not self._dial_gate.may_dial(peer_address):
+                raise TimeoutIOException(
+                    f"client->{peer_address} request stream re-dial pacing")
+            if stream is not None:
+                await stream.close()  # release the dead stream's call
             stream = _AppendStreamClient(
                 lambda: self._pool.stream(peer_address,
                                           _REQUEST_STREAM_METHOD)())
@@ -715,12 +779,14 @@ class GrpcClientTransport(ClientTransport):
             # stream carries every other in-flight request to this server);
             # a mid-write timeout already failed the stream — drop it
             if stream.closed:
-                self._streams.pop(peer_address, None)
+                if self._streams.get(peer_address) is stream:
+                    self._streams.pop(peer_address, None)
                 await stream.close()
             raise TimeoutIOException(
                 f"client->{peer_address} request timed out") from None
         except Exception as e:
-            self._streams.pop(peer_address, None)
+            if self._streams.get(peer_address) is stream:
+                self._streams.pop(peer_address, None)
             await stream.close()
             raise TimeoutIOException(
                 f"client->{peer_address} request stream: {e}") from None

@@ -192,8 +192,14 @@ def test_grpc_separate_client_port():
                                     Message.value_of(b"INCREMENT"),
                                     type=write_request_type(),
                                     timeout_ms=10000)
-            reply = await client.send_request(
-                f"{host}:{srv.transport.bound_client_port}", req)
+            from ratis_tpu.protocol.exceptions import \
+                LeaderNotReadyException
+            for _ in range(100):
+                reply = await client.send_request(
+                    f"{host}:{srv.transport.bound_client_port}", req)
+                if not isinstance(reply.exception, LeaderNotReadyException):
+                    break  # a real client retries not-ready the same way
+                await asyncio.sleep(0.05)
             assert reply.success, reply.exception
             # the replication port no longer serves the client plane
             from ratis_tpu.protocol.exceptions import (RaftException,
