@@ -470,11 +470,13 @@ def main() -> None:
             "over direct function-call transport (socket costs removed); "
             "kernel_vs_scalar_loop is the kernel batching effect in "
             "isolation; peer5_10240 is BASELINE config 3's true shape "
-            "(5-peer x 10240 groups) run end to end; over gRPC the scalar "
-            "cost shape cannot bring up >=512 groups at all (grpc_1024."
-            "scalar_dnf) - the batched/coalesced design is the difference "
-            "between running and not running at that scale"
-            % (TRIALS, HEADLINE_GROUPS)),
+            "(5-peer x 10240 groups) run end to end; grpc_1024 compares "
+            "both engine modes over the reference's primary transport "
+            "analog (the scalar shape completes there only on top of this "
+            "framework's storm containment - before the round-5 "
+            "confirmed-contact heartbeats and dial pacing it could not "
+            "bring up >=512 groups; scalar_dnf records whether it "
+            "completed this run)" % (TRIALS, HEADLINE_GROUPS)),
         "secondary": {
             "groups": HEADLINE_GROUPS,
             "trials": TRIALS,
