@@ -381,13 +381,19 @@ def main() -> None:
                            "warmup": 0 if groups > 4096 else 1})
         ladder[groups] = _run_trials(spec, trials, timeout_s=1800.0)
 
-    # NORTH STAR (BASELINE config 3's true shape): 5-peer x 10240 groups.
-    # Appointed-leader bootstrap + gc discipline + bulk chunking brought
-    # bring-up from >29min (r4 boundary) to ~2min.
+    # NORTH STAR (BASELINE config 3's true shape): 5-peer x 10240 groups
+    # over REAL TCP sockets, batched vs the reference's scalar cost shape.
+    # Appointed-leader bootstrap + gc discipline + bulk chunking +
+    # confirmed-contact heartbeats brought bring-up from >29min (r4
+    # boundary) to ~30-40s.
     peer5 = _run_child(["--e2e-child", json.dumps(
         {"groups": 10_240, "writes": 2, "batched": True,
-         "concurrency": 128, "transport": "sim", "peers": 5,
+         "concurrency": 128, "transport": "tcp", "peers": 5,
          "warmup": 0})], timeout_s=1800.0)
+    peer5_scalar = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 2, "batched": False,
+         "concurrency": 128, "transport": "tcp", "peers": 5,
+         "warmup": 0})], timeout_s=1800.0, allow_dnf=True)
 
     # Config 5 probe: the 7-peer shape at reduced group count, plus the
     # engine capacity at the full 100k-group count (kernel child below).
@@ -470,7 +476,9 @@ def main() -> None:
             "over direct function-call transport (socket costs removed); "
             "kernel_vs_scalar_loop is the kernel batching effect in "
             "isolation; peer5_10240 is BASELINE config 3's true shape "
-            "(5-peer x 10240 groups) run end to end; grpc_1024 compares "
+            "(5-peer x 10240 groups) run end to end over real TCP, with "
+            "vs_scalar comparing the same harness in the reference cost "
+            "shape at that exact configuration; grpc_1024 compares "
             "both engine modes over the reference's primary transport "
             "analog (the scalar shape completes there only on top of this "
             "framework's storm containment - before the round-5 "
@@ -492,16 +500,26 @@ def main() -> None:
                 for r in (headline, scalar, grpc_b, *ladder.values())
                 for t in r) + sum(
                 t.get("write_failures", 0)
-                for t in (peer5, peer7, mesh, grpc_s_1024, grpc_s_256,
-                          sparse_hib, sparse_plain, churn, mixed)
+                for t in (peer5, peer5_scalar, peer7, mesh, grpc_s_1024,
+                          grpc_s_256, sparse_hib, sparse_plain, churn,
+                          mixed)
                 if isinstance(t, dict)),
             "scalar_mode_commits_per_sec": _median(scalar_cps),
             "peer5_10240": {
+                "transport": "tcp",
                 "commits_per_sec": peer5["commits_per_sec"],
                 "p50_ms": peer5["p50_ms"],
                 "p99_ms": peer5["p99_ms"],
                 "bringup_s": peer5["election_convergence_s"],
                 "peers": 5,
+                "scalar_commits_per_sec": peer5_scalar.get(
+                    "commits_per_sec"),
+                "scalar_p99_ms": peer5_scalar.get("p99_ms"),
+                "scalar_dnf": bool(peer5_scalar.get("dnf")),
+                "vs_scalar": (
+                    round(peer5["commits_per_sec"]
+                          / peer5_scalar["commits_per_sec"], 2)
+                    if peer5_scalar.get("commits_per_sec") else None),
             },
             "peer7_2048": {
                 "commits_per_sec": peer7["commits_per_sec"],
