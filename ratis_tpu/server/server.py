@@ -410,8 +410,10 @@ class RaftServer:
         if freeze_idle_s <= 0 and refreeze_s <= 0:
             return
         from ratis_tpu.util import gcdiscipline
-        poll = max(min(freeze_idle_s / 2 if freeze_idle_s > 0 else 5.0,
-                       5.0), 0.05)
+        # poll fast enough for the FASTEST configured cadence, or a
+        # sub-interval refreeze would silently quantize to the default poll
+        cadences = [c / 2 for c in (freeze_idle_s, refreeze_s) if c > 0]
+        poll = max(min(*cadences, 5.0) if cadences else 5.0, 0.05)
         while True:
             await asyncio.sleep(poll)
             due = (freeze_idle_s > 0

@@ -42,6 +42,7 @@ _saved_thresholds = None    # thresholds to restore when _active drops to 0
 _mutation_clock = 0.0       # monotonic time of the last group-set mutation
 _sealed_at = -1.0           # _mutation_clock value covered by the last seal
 _last_seal_s = 0.0          # monotonic time of the last seal (any cause)
+seal_count = 0              # total seals this process (observable for tests)
 
 
 def enable() -> None:
@@ -94,9 +95,10 @@ def seal() -> float:
     """One deliberate full collection + freeze; returns its duration so
     callers can log/assert the pause they chose to take now instead of
     letting the collector take it mid-consensus later."""
-    global _sealed_at, _last_seal_s
+    global _sealed_at, _last_seal_s, seal_count
     _sealed_at = _mutation_clock
     _last_seal_s = time.monotonic()
+    seal_count += 1
     t0 = time.monotonic()
     gc.collect()
     gc.freeze()

@@ -62,6 +62,33 @@ def test_janitor_seals_after_group_burst_and_restores_on_close():
         gc.unfreeze()
 
 
+def test_refreeze_interval_reseals_on_cadence():
+    """Steady-state re-freeze (raft.tpu.gc.refreeze-interval): the janitor
+    seals repeatedly on the cadence even with NO group mutations, moving
+    load-accreted live objects out of the collector's walks."""
+    saved = gc.get_threshold()
+    frozen_before = gc.get_freeze_count()
+
+    async def body(cluster: MiniCluster):
+        start = gcdiscipline.seal_count
+        deadline = asyncio.get_event_loop().time() + 8.0
+        while asyncio.get_event_loop().time() < deadline:
+            if gcdiscipline.seal_count >= start + 2:
+                break  # REPEATED seals observed, not just the first
+            await asyncio.sleep(0.1)
+        assert gcdiscipline.seal_count >= start + 2, \
+            "janitor did not keep re-sealing on the cadence"
+        assert gc.get_freeze_count() > frozen_before
+
+    p = _gc_properties(freeze_idle="0s")  # idle-seal OFF: cadence only
+    p.set(RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_KEY, "300ms")
+    try:
+        run_with_new_cluster(3, body, properties=p)
+    finally:
+        gc.set_threshold(*saved)
+        gc.unfreeze()
+
+
 def test_discipline_off_leaves_gc_alone():
     saved = gc.get_threshold()
 
