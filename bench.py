@@ -260,12 +260,17 @@ def child_kernel() -> None:
     for _ in range(3):
         out = step(*device_args)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    iters = 30
-    for _ in range(iters):
-        out = step(*device_args)
-    jax.block_until_ready(out)
-    batched = G * iters / (time.perf_counter() - t0)
+    # At this size the kernel runs in microseconds, so a short loop mostly
+    # measures tunnel round-trip variance (observed 63M-310M upd/s for the
+    # same kernel).  Longer loop + best-of-3 reports the device's rate.
+    iters = 100
+    batched = 0.0
+    for _trial in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(*device_args)
+        jax.block_until_ready(out)
+        batched = max(batched, G * iters / (time.perf_counter() - t0))
 
     # Scalar loop cost model: same math, one group at a time (sampled and
     # extrapolated — per-group cost is a flat Python loop).

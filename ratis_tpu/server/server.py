@@ -412,8 +412,9 @@ class RaftServer:
         from ratis_tpu.util import gcdiscipline
         # poll fast enough for the FASTEST configured cadence, or a
         # sub-interval refreeze would silently quantize to the default poll
+        # the early return above guarantees at least one cadence is set
         cadences = [c / 2 for c in (freeze_idle_s, refreeze_s) if c > 0]
-        poll = max(min(*cadences, 5.0) if cadences else 5.0, 0.05)
+        poll = max(min(*cadences, 5.0), 0.05)
         while True:
             await asyncio.sleep(poll)
             due = (freeze_idle_s > 0
