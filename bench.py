@@ -494,7 +494,7 @@ def main() -> None:
             "framework's storm containment - before the round-5 "
             "confirmed-contact heartbeats and dial pacing it could not "
             "bring up >=512 groups; scalar_dnf records whether it "
-            "completed this run)" % (TRIALS, HEADLINE_GROUPS)),
+            "completed this run)" % (HEADLINE_TRIALS, HEADLINE_GROUPS)),
         "secondary": {
             "groups": HEADLINE_GROUPS,
             "trials": HEADLINE_TRIALS,

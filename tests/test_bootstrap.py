@@ -53,6 +53,28 @@ def test_bootstrap_refuses_non_fresh_group():
     run_with_new_cluster(3, body, properties=fast_properties())
 
 
+def test_bootstrap_refuses_non_voting_member():
+    async def body(cluster: MiniCluster):
+        listener = next(
+            d for s in cluster.servers.values()
+            for d in s.divisions.values() if d.is_listener())
+        # a LISTENER-role division trips the follower/fresh-state guard
+        with pytest.raises(RaftException, match="fresh"):
+            await listener.bootstrap_as_leader()
+        # the deeper invariant: even a FOLLOWER-role division that the
+        # configuration lists as non-voting must be refused (white-box:
+        # flip the role so the first guard passes and the voting guard is
+        # the one that fires)
+        from ratis_tpu.server.division import RaftPeerRole
+        listener.role = RaftPeerRole.FOLLOWER
+        with pytest.raises(RaftException, match="non-voting"):
+            await listener.bootstrap_as_leader()
+        listener.role = RaftPeerRole.LISTENER
+
+    run_with_new_cluster(2, body, properties=_quiet_properties(),
+                         num_listeners=1)
+
+
 def test_bootstrap_survives_batched_engine_mode():
     async def body(cluster: MiniCluster):
         d = next(iter(cluster.servers.values())) \
