@@ -52,6 +52,7 @@ from ratis_tpu.protocol.requests import (RaftClientReply, RaftClientRequest,
                                          write_request_type)
 from ratis_tpu.retry.policies import (ClientRetryEvent, RetryPolicies,
                                       RetryPolicy)
+from ratis_tpu.trace.tracer import STAGE_CLIENT, TRACER
 from ratis_tpu.transport.base import ClientTransport
 from ratis_tpu.util.timeduration import TimeDuration
 
@@ -177,15 +178,16 @@ class RaftClient:
     def _new_request(self, message: Message, type_case: TypeCase,
                      server_id: Optional[RaftPeerId] = None,
                      timeout_ms: float = 3000.0,
-                     group_id: Optional[RaftGroupId] = None
-                     ) -> RaftClientRequest:
+                     group_id: Optional[RaftGroupId] = None,
+                     trace_id: int = 0) -> RaftClientRequest:
         replied = tuple(self._replied_call_ids)
         self._replied_call_ids.clear()
         return RaftClientRequest(
             self.client_id,
             server_id or self._leader_id or self._next_peer(None),
             group_id or self.group_id, next(self._call_ids), message,
-            type=type_case, timeout_ms=timeout_ms, replied_call_ids=replied)
+            type=type_case, timeout_ms=timeout_ms, replied_call_ids=replied,
+            trace_id=trace_id)
 
     async def send_request_with_retry(self, message: Message,
                                       type_case: TypeCase,
@@ -199,9 +201,11 @@ class RaftClient:
         (SlidingWindowClient, seqNum): each attempt carries the seqNum and a
         per-attempt recomputed isFirst flag, and failover resets the window's
         first marker (reference OrderedAsync.java:59 resetSlidingWindow)."""
+        trace_id = TRACER.begin_trace()
         req = self._new_request(message, type_case, server_id, timeout_ms,
-                                group_id)
+                                group_id, trace_id=trace_id)
         sticky = server_id is not None  # explicit target: no failover
+        t0 = TRACER.now() if trace_id else 0
         try:
             return await self._retry_loop(req, sticky, ordering)
         except BaseException:
@@ -210,6 +214,9 @@ class RaftClient:
             # returns ids to the pending set on failure)
             self._replied_call_ids.update(req.replied_call_ids)
             raise
+        finally:
+            if trace_id:
+                TRACER.record(trace_id, STAGE_CLIENT, t0, TRACER.now())
 
     async def _retry_loop(self, req: RaftClientRequest, sticky: bool,
                           ordering: Optional[tuple] = None
@@ -237,7 +244,8 @@ class RaftClient:
                         slider_seq_num=seq,
                         slider_first=(window.is_first(seq)
                                       if window is not None else False),
-                        replied_call_ids=req.replied_call_ids)
+                        replied_call_ids=req.replied_call_ids,
+                        trace_id=req.trace_id)
                     reply = await self.transport.send_request(
                         address, attempt_req)
                 except (TimeoutIOException, asyncio.TimeoutError,

@@ -472,6 +472,40 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_KEY,
                 RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_DEFAULT)
 
+    class Trace:
+        """Host-path tracing (ratis_tpu.trace; no reference analog — the
+        reference leans on JVM profilers): per-stage request->commit spans
+        recorded into fixed-size ring buffers, exportable as a percentile
+        decomposition table and Chrome trace-event JSON (Perfetto).  OFF by
+        default; when enabled, every ``sample-every``-th client request is
+        traced end to end and process-level stages (rpc codec, engine
+        dispatch) sample at the same rate."""
+
+        ENABLED_KEY = "raft.tpu.trace.enabled"
+        ENABLED_DEFAULT = False
+        SAMPLE_EVERY_KEY = "raft.tpu.trace.sample-every"
+        SAMPLE_EVERY_DEFAULT = 16
+        RING_SIZE_KEY = "raft.tpu.trace.ring-size"
+        RING_SIZE_DEFAULT = 4096
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Trace.ENABLED_KEY,
+                RaftServerConfigKeys.Trace.ENABLED_DEFAULT)
+
+        @staticmethod
+        def sample_every(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Trace.SAMPLE_EVERY_KEY,
+                RaftServerConfigKeys.Trace.SAMPLE_EVERY_DEFAULT)
+
+        @staticmethod
+        def ring_size(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Trace.RING_SIZE_KEY,
+                RaftServerConfigKeys.Trace.RING_SIZE_DEFAULT)
+
     class Notification:
         NO_LEADER_TIMEOUT_KEY = "raft.server.notification.no-leader.timeout"
         NO_LEADER_TIMEOUT_DEFAULT = TimeDuration.valueOf("60s")

@@ -28,6 +28,7 @@ from ratis_tpu.engine.state import (GroupBatchState, NO_DEADLINE,
                                     ROLE_CANDIDATE, ROLE_FOLLOWER,
                                     ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
 from ratis_tpu.ops import reference as ref
+from ratis_tpu.trace.tracer import STAGE_ENGINE, TRACER
 
 # keep in sync with ops.quorum.PACK_SENTINEL (not imported here: engine
 # import must not eagerly pull in jax)
@@ -613,6 +614,8 @@ class QuorumEngine:
         if check_stale:
             self._next_staleness_ms = now + max(
                 1, self.leadership_timeout_ms // 4)
+        trace_t0 = (TRACER.now() if touched
+                    and TRACER.enabled and TRACER.sample() else 0)
 
         for slot in list(s.active):
             role = s.role[slot]
@@ -634,6 +637,9 @@ class QuorumEngine:
             elif role == ROLE_FOLLOWER and now >= s.election_deadline_ms[slot]:
                 s.election_deadline_ms[slot] = NO_DEADLINE  # re-armed by div
                 changed.append((slot, "timeout", 0))
+        if trace_t0:
+            TRACER.record(0, STAGE_ENGINE, trace_t0, TRACER.now(),
+                          tag=len(touched))
         return changed
 
     # -- batched path --------------------------------------------------------
@@ -802,6 +808,10 @@ class QuorumEngine:
 
         s = self.state
         self.metrics["batched_dispatches"] += 1
+        # engine.dispatch host-path span (process-level, sampled): the
+        # device round-trip cost per dispatch, tag = packed event count
+        trace_t0 = (TRACER.now()
+                    if TRACER.enabled and TRACER.sample() else 0)
 
         if self._dev is None or self._dev.match_index.shape != s.match_index.shape:
             # first batched tick / capacity regrow / epoch rebase: one full
@@ -824,8 +834,12 @@ class QuorumEngine:
                            [now, self.leadership_timeout_ms], np.int32)))
             self._dev = res.state
             out = np.asarray(res.out)
-            return self._collect_changed(out[0], out[1] != 0, out[2] != 0,
-                                         out[3] != 0)
+            changed = self._collect_changed(out[0], out[1] != 0, out[2] != 0,
+                                            out[3] != 0)
+            if trace_t0:
+                TRACER.record(0, STAGE_ENGINE, trace_t0, TRACER.now(),
+                              tag=len(acks))
+            return changed
 
         # dirty-row refresh: O(changed slots) host->device.  Slots with
         # queued packed updates fold in here — the mirror already holds
@@ -868,9 +882,13 @@ class QuorumEngine:
 
         # downloads: only the [G] outputs (masks + commit values), never the
         # [G, P] state
-        return self._collect_changed(
+        changed = self._collect_changed(
             np.asarray(res.new_commit), np.asarray(res.commit_changed),
             np.asarray(res.timeouts), np.asarray(res.stale))
+        if trace_t0:
+            TRACER.record(0, STAGE_ENGINE, trace_t0, TRACER.now(),
+                          tag=len(acks))
+        return changed
 
     def _collect_changed(self, new_commit_np, commit_changed_np, timeouts_np,
                          stale_np) -> list[tuple[int, str, int]]:

@@ -21,6 +21,7 @@ import msgpack
 from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
 from ratis_tpu.protocol.logentry import LogEntry
 from ratis_tpu.protocol.termindex import TermIndex
+from ratis_tpu.trace.tracer import STAGE_DECODE, STAGE_ENCODE, TRACER
 
 
 @dataclasses.dataclass(frozen=True)
@@ -427,11 +428,27 @@ _TYPE_TAGS = {v: k for k, v in _MSG_TYPES.items()}
 
 
 def encode_rpc(msg) -> bytes:
-    """Tagged msgpack envelope (cf. Netty.proto's request/reply union:31-48)."""
+    """Tagged msgpack envelope (cf. Netty.proto's request/reply union:31-48).
+
+    Host-path tracing samples the encode here (process-level span,
+    ratis_tpu.trace STAGE_ENCODE, tag = wire bytes): the per-commit msgpack
+    cost of the server-to-server plane, measured where it is paid."""
+    if TRACER.enabled and TRACER.sample():
+        t0 = TRACER.now()
+        b = msgpack.packb({"_": _TYPE_TAGS[type(msg)], "b": msg.to_dict()},
+                          use_bin_type=True)
+        TRACER.record(0, STAGE_ENCODE, t0, TRACER.now(), tag=len(b))
+        return b
     return msgpack.packb({"_": _TYPE_TAGS[type(msg)], "b": msg.to_dict()},
                          use_bin_type=True)
 
 
 def decode_rpc(b: bytes):
+    if TRACER.enabled and TRACER.sample():
+        t0 = TRACER.now()
+        d = msgpack.unpackb(b, raw=False)
+        out = _MSG_TYPES[d["_"]].from_dict(d["b"])
+        TRACER.record(0, STAGE_DECODE, t0, TRACER.now(), tag=len(b))
+        return out
     d = msgpack.unpackb(b, raw=False)
     return _MSG_TYPES[d["_"]].from_dict(d["b"])

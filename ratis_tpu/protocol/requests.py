@@ -122,6 +122,10 @@ class RaftClientRequest:
     # Piggybacked already-replied call ids for server retry-cache GC
     # (reference RaftClientImpl.RepliedCallIds, RaftClientImpl.java:128).
     replied_call_ids: tuple[int, ...] = ()
+    # Host-path trace context (ratis_tpu.trace): 0 = untraced; a sampled
+    # request carries its trace id across the wire so client, transport,
+    # server, and apply spans share one id.
+    trace_id: int = 0
 
     def is_write(self) -> bool:
         return self.type.type == RequestType.WRITE
@@ -134,7 +138,7 @@ class RaftClientRequest:
 
     def to_dict(self) -> dict:
         t = self.type
-        return {
+        d = {
             "cid": self.client_id.to_bytes(), "sid": self.server_id.id,
             "gid": self.group_id.to_bytes(), "call": self.call_id,
             "msg": self.message.content, "seq": self.slider_seq_num,
@@ -146,6 +150,9 @@ class RaftClientRequest:
                   "wr": int(t.watch_replication), "si": t.stream_id,
                   "mi": t.message_id, "eor": t.end_of_request},
         }
+        if self.trace_id:
+            d["tr"] = self.trace_id  # only sampled requests pay the byte
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "RaftClientRequest":
@@ -159,6 +166,7 @@ class RaftClientRequest:
             slider_first=d.get("sf", False),
             timeout_ms=d.get("to", 3000.0),
             replied_call_ids=tuple(d.get("rcids", ())),
+            trace_id=d.get("tr", 0),
             type=TypeCase(RequestType(t["t"]), read_nonlinearizable=t["rnl"],
                           read_after_write_consistent=t.get("raw", False),
                           stale_read_min_index=t["smi"], watch_index=t["wi"],

@@ -36,6 +36,13 @@ LOG = logging.getLogger(__name__)
 LinkKey = Tuple[bytes, int]  # (clientId, callId) of the header request
 
 
+def _consume_result(fut: asyncio.Future) -> None:
+    """Retrieve an abandoned ack future's outcome so the loop never logs
+    'exception never retrieved' for a failure path we already handled."""
+    if not fut.cancelled():
+        fut.exception()
+
+
 class StreamInfo:
     """One receiving stream on one peer (reference StreamInfo:88-193)."""
 
@@ -241,13 +248,31 @@ class DataStreamManagement:
             raise DataStreamException(
                 f"stream {packet.stream_id}: out-of-order offset "
                 f"{packet.offset}, expected {info.next_offset}")
-        written = await info.local.channel.write(packet.data)
-        if written != len(packet.data):
-            raise DataStreamException(
-                f"short write {written}/{len(packet.data)}")
-        # sends happen NOW, in read-loop order (per-successor FIFO); only
-        # the ack futures move to the completion task
-        ack_futs = [await r.send(packet) for r in info.remotes]
+        ack_futs: list = []
+        try:
+            written = await info.local.channel.write(packet.data)
+            if written != len(packet.data):
+                raise DataStreamException(
+                    f"short write {written}/{len(packet.data)}")
+            # sends happen NOW, in read-loop order (per-successor FIFO);
+            # only the ack futures move to the completion task
+            for r in info.remotes:
+                ack_futs.append(await r.send(packet))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # Poison the stream OURSELVES (later packets and the CLOSE fail
+            # fast server-side instead of relying on the client reacting to
+            # the failure reply), and consume/cancel the earlier
+            # successors' ack futures — abandoned, their eventual
+            # set_exception would surface as 'exception never retrieved'
+            # noise with no handler (ADVICE r5).
+            info.failed = e if isinstance(e, DataStreamException) \
+                else DataStreamException(str(e))
+            for fut in ack_futs:
+                fut.add_done_callback(_consume_result)
+                fut.cancel()
+            raise
         info.next_offset += len(packet.data)
         info.bytes_written += len(packet.data)
         if packet.is_sync:

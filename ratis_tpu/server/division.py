@@ -53,6 +53,8 @@ from ratis_tpu.server.election import LeaderElection
 from ratis_tpu.server.leader import FollowerInfo, LeaderContext
 from ratis_tpu.server.state import ServerState
 from ratis_tpu.server.statemachine import StateMachine, TransactionContext
+from ratis_tpu.trace.tracer import (STAGE_APPEND, STAGE_APPLY, STAGE_REPLY,
+                                    STAGE_REPLICATE, STAGE_TXN, TRACER)
 from ratis_tpu.util import injection
 
 LOG = logging.getLogger(__name__)
@@ -193,6 +195,13 @@ class Division:
         # per-client ordered-async reorder windows (leader only; see
         # _write_ordered)
         self._client_windows: dict = {}
+        # Host-path tracing: log index -> (trace_id, append-done ns) for
+        # sampled writes in flight between append and apply; _apply_one
+        # pops each to close the replicate span and open the apply span,
+        # then parks (trace_id, apply-done ns) in _trace_applied for the
+        # write handler to close the reply span when its future resumes.
+        self._trace_pending: dict[int, tuple[int, int]] = {}
+        self._trace_applied: dict[int, tuple[int, int]] = {}
         # peer -> last known commit index (reference CommitInfoCache,
         # RaftServerImpl commitInfoCache): fed by our own commit advances,
         # follower reply piggybacks (leader) and leader request piggybacks
@@ -577,6 +586,16 @@ class Division:
                     now - self._last_hib_slow_tick \
                     >= self._hibernate_backstop_s / 4:
                 self._last_hib_slow_tick = now
+                # The slow tick MUST actually send: heartbeat_item's
+                # confirmed-contact gate (0.9*hb fresh-reply / 0.45*hb
+                # send-cap) would otherwise suppress it whenever backstop
+                # < ~4x the heartbeat interval — the tick counted as sent
+                # here while followers heard nothing, and their backstop
+                # deadlines expired in a perfectly healthy sleeping group
+                # (ADVICE r5).  _last_send_s == 0.0 is the explicit
+                # force-due marker heartbeat_item honors.
+                for a in self.leader_ctx.appenders.values():
+                    a._last_send_s = 0.0
                 return "request"
             return "asleep"
         if not self._quiescent():
@@ -729,10 +748,35 @@ class Division:
             raise RaftException(
                 f"{self.member_id}: appointed bootstrap of a non-voting "
                 f"member")
+        # Deterministic appointee: the fresh-state guard above is peer-
+        # LOCAL, so without this check two appointees on the same fresh
+        # group would both pass it and become two term-1 leaders whose
+        # conflicting index-1 entries can each gather acks (ADVICE r5).
+        # Deriving the one legitimate appointee from the configuration
+        # itself (highest priority, ties broken by lowest peer id) makes a
+        # double appointment fail CLOSED on every peer but one, with no
+        # coordination or persisted marker needed.
+        appointee = self.bootstrap_appointee()
+        if appointee != self.member_id.peer_id:
+            raise RaftException(
+                f"{self.member_id}: not the bootstrap appointee — this "
+                f"configuration appoints {appointee} (highest priority, "
+                f"lowest peer id); appointing anyone else risks two "
+                f"term-1 leaders on the same group")
         await self.state.init_election_term()
         self.role = RaftPeerRole.CANDIDATE
         self._engine_set_role(ROLE_CANDIDATE)
         await self.change_to_leader()
+
+    def bootstrap_appointee(self) -> RaftPeerId:
+        """The one peer this configuration allows to bootstrap_as_leader:
+        the voting peer with the highest priority, ties broken by lowest
+        peer id — deterministic from the conf every peer shares."""
+        voting = self.state.configuration.voting_peers()
+        if not voting:
+            raise RaftException(
+                f"{self.member_id}: configuration has no voting peers")
+        return min(voting, key=lambda p: (-p.priority, p.id.id)).id
 
     async def change_to_leader(self) -> None:
         assert self.is_candidate()
@@ -802,6 +846,8 @@ class Division:
             self.state.set_leader(None)
         if old_role == RaftPeerRole.LEADER and self.leader_ctx is not None:
             self.message_stream_requests.clear()
+            self._trace_pending.clear()  # entries may truncate; never apply
+            self._trace_applied.clear()
             ctx = self.leader_ctx
             self.leader_ctx = None
             nle = NotLeaderException(self.member_id, self.get_leader_peer(),
@@ -1669,6 +1715,8 @@ class Division:
                           on_submitted=None) -> RaftClientReply:
         await injection.execute(injection.APPEND_TRANSACTION, self.member_id,
                                 req.client_id)
+        tid = req.trace_id if TRACER.enabled else 0
+        t0 = TRACER.now() if tid else 0
         try:
             trx = await self.state_machine.start_transaction(req)
         except Exception as e:
@@ -1679,6 +1727,8 @@ class Division:
                 req, StateMachineException(str(trx.exception),
                                            cause=trx.exception))
         trx = await self.state_machine.pre_append_transaction(trx)
+        if tid:
+            TRACER.record(tid, STAGE_TXN, t0, TRACER.now())
 
         log = self.state.log
         index = log.next_index
@@ -1698,12 +1748,25 @@ class Division:
         # append; the fsync overlaps the follower RPCs the appenders start
         # right below, and the flush callback advances the engine's
         # flush_index (the leader's self-slot commit input) when it lands.
+        if tid:
+            t0 = TRACER.now()
         await log.append_entry(entry, wait_flush=False)
+        if tid:
+            now = TRACER.now()
+            TRACER.record(tid, STAGE_APPEND, t0, now)
+            self._trace_pending[index] = (tid, now)
         self._engine_update_flush()
         self.leader_ctx.notify_appenders()
         if on_submitted is not None:
             on_submitted()  # appended: the ordered window may release the next
-        return await pending.future
+        reply = await pending.future
+        if tid:
+            done = self._trace_applied.pop(index, None)
+            if done is not None:
+                # apply done -> this coroutine resumed: the reply span is
+                # pure future-resolution + event-loop scheduling cost
+                TRACER.record(tid, STAGE_REPLY, done[1], TRACER.now())
+        return reply
 
     async def _read_async(self, req: RaftClientRequest) -> RaftClientReply:
         with self.metrics.read_timer.time():
@@ -2034,6 +2097,13 @@ class Division:
         sm = self.state_machine
         reply_message: Optional[Message] = None
         exception: Optional[Exception] = None
+        trace = (self._trace_pending.pop(entry.index, None)
+                 if self._trace_pending else None)
+        if trace is not None:
+            # close the replicate span (append done -> apply starts: quorum
+            # wait + apply-queue wait) and open the apply span
+            t_apply0 = TRACER.now()
+            TRACER.record(trace[0], STAGE_REPLICATE, trace[1], t_apply0)
         if entry.kind == LogEntryKind.STATE_MACHINE:
             trx = self.server.transactions.pop((self.group_id, entry.index), None)
             if trx is None or trx.log_entry is None \
@@ -2089,6 +2159,10 @@ class Division:
             await self._on_conf_entry_applied(entry)
         if self._sm_wants_term_index:
             await sm.notify_term_index_updated(entry.term, entry.index)
+        if trace is not None:
+            now = TRACER.now()
+            TRACER.record(trace[0], STAGE_APPLY, t_apply0, now)
+            self._trace_applied[entry.index] = (trace[0], now)
 
         if self.is_leader() and self.leader_ctx is not None:
             pending = self.leader_ctx.pending.pop(entry.index)

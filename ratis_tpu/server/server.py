@@ -218,6 +218,10 @@ class RaftServer:
         self.peer_id = peer_id
         self.address = address
         self.properties = properties
+        # Host-path tracing (ratis_tpu.trace): enables the process-wide
+        # tracer when raft.tpu.trace.enabled is set; a no-op otherwise.
+        from ratis_tpu.trace import configure_from_properties
+        configure_from_properties(properties)
         self._sm_registry = state_machine_registry
         self._initial_group = group
         self._log_factory = log_factory
@@ -633,6 +637,13 @@ class RaftServer:
     async def _handle_client_request(self, request: RaftClientRequest
                                      ) -> RaftClientReply:
         from ratis_tpu.protocol.requests import RequestType
+        from ratis_tpu.trace.tracer import INGRESS_NS, STAGE_ROUTE, TRACER
+        trace_t0 = 0
+        if TRACER.enabled and request.trace_id:
+            # route starts at transport ingress when the transport stamped
+            # it (captures the ingress->handler scheduling hop), else here
+            trace_t0 = INGRESS_NS.get() or TRACER.now()
+            INGRESS_NS.set(0)  # single-use: never bleed into a later call
         t = request.type.type
         if t == RequestType.GROUP_MANAGEMENT:
             return await self._group_management(request)
@@ -645,13 +656,21 @@ class RaftServer:
             div = self.get_division(request.group_id)
         except GroupMismatchException as e:
             return RaftClientReply.failure_reply(request, e)
+        if trace_t0:
+            TRACER.record(request.trace_id, STAGE_ROUTE, trace_t0,
+                          TRACER.now())
         try:
-            return await div.submit_client_request(request)
+            reply = await div.submit_client_request(request)
         except RaftException as e:
             return RaftClientReply.failure_reply(request, e)
         except Exception as e:  # never leak raw errors to the wire
             LOG.exception("%s request failed", self.peer_id)
             return RaftClientReply.failure_reply(request, RaftException(str(e)))
+        if trace_t0:
+            # the transport pops this to close the respond span (handler
+            # done -> reply serialized/handed back)
+            TRACER.mark_egress(request.trace_id)
+        return reply
 
     async def submit_data_stream_request(self, request: RaftClientRequest
                                          ) -> RaftClientReply:

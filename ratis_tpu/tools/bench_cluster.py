@@ -49,7 +49,9 @@ def bench_properties(batched: bool, num_groups: int = 1,
                      hibernate: bool = False,
                      mesh_devices: int = 0,
                      num_servers: int = 3,
-                     transport: str = "sim") -> RaftProperties:
+                     transport: str = "sim",
+                     trace: bool = False,
+                     trace_sample: int = 16) -> RaftProperties:
     from ratis_tpu.engine.engine import QuorumEngine
     p = RaftProperties()
     # Timeouts scale with CHANNEL density (groups x followers): background
@@ -121,6 +123,12 @@ def bench_properties(batched: bool, num_groups: int = 1,
         # measured e2e number, not just dryrun bit-identity)
         p.set(RaftServerConfigKeys.Engine.MESH_DEVICES_KEY,
               str(mesh_devices))
+    if trace:
+        # host-path tracing (ratis_tpu.trace): every trace_sample-th write
+        # records request->commit stage spans; exported by run_bench as the
+        # host_path_decomposition block + Chrome trace-event JSON
+        p.set(RaftServerConfigKeys.Trace.ENABLED_KEY, "true")
+        p.set(RaftServerConfigKeys.Trace.SAMPLE_EVERY_KEY, str(trace_sample))
     if batched:
         # TPU-native execution mode: every tick runs the jitted kernel over
         # all groups, and append traffic toward each destination server is
@@ -150,7 +158,8 @@ class BenchCluster:
     def __init__(self, num_groups: int, num_servers: int = 3,
                  batched: bool = True, transport: str = "sim",
                  sm: str = "counter", datastream: bool = False,
-                 hibernate: bool = False, mesh_devices: int = 0):
+                 hibernate: bool = False, mesh_devices: int = 0,
+                 trace: bool = False, trace_sample: int = 16):
         self.num_groups = num_groups
         self.batched = batched
         self.transport = transport
@@ -158,6 +167,7 @@ class BenchCluster:
         self.datastream = datastream
         self.hibernate = hibernate
         self.mesh_devices = mesh_devices
+        self.trace = trace
         if transport in ("tcp", "grpc"):
             # Real localhost sockets: every RPC pays framing + syscalls, so
             # the per-(group,follower) stream shape costs what it costs the
@@ -192,7 +202,9 @@ class BenchCluster:
                                            hibernate=hibernate,
                                            mesh_devices=mesh_devices,
                                            num_servers=num_servers,
-                                           transport=transport)
+                                           transport=transport,
+                                           trace=trace,
+                                           trace_sample=trace_sample)
         if self.network is not None:
             # the sim's default 3s rpc deadline models a small cluster; a
             # legitimately-busy handler at thousands of co-hosted groups
@@ -337,19 +349,26 @@ class BenchCluster:
             timeout = 60.0 if self.num_groups < 8192 else 240.0
         server = self._leader_hint.get(gid, self.servers[0])
         deadline = time.monotonic() + timeout
+        from ratis_tpu.trace.tracer import STAGE_CLIENT, TRACER
         while True:
             # bounded per-attempt deadline: one stuck call must cost one
             # attempt, not the write's whole retry budget (the client
             # transport's 30s default ate 2 of the 60s budget per hang)
+            trace_id = TRACER.begin_trace()
             req = RaftClientRequest(client_id, server.peer_id, gid,
                                     next(self._call_ids),
                                     Message.value_of(message),
                                     type=write_request_type(),
-                                    timeout_ms=10_000.0)
+                                    timeout_ms=10_000.0,
+                                    trace_id=trace_id)
+            t0 = TRACER.now() if trace_id else 0
             try:
                 reply = await client.send_request(server.address, req)
             except (RaftException, asyncio.TimeoutError):
                 reply = None
+            finally:
+                if trace_id:
+                    TRACER.record(trace_id, STAGE_CLIENT, t0, TRACER.now())
             if reply is not None and reply.success:
                 self._leader_hint[gid] = server
                 return reply
@@ -441,7 +460,8 @@ class BenchCluster:
 async def _started_cluster(num_groups: int, batched: bool,
                            transport: str = "sim", sm: str = "counter",
                            datastream: bool = False, num_servers: int = 3,
-                           hibernate: bool = False, mesh_devices: int = 0):
+                           hibernate: bool = False, mesh_devices: int = 0,
+                           trace: bool = False, trace_sample: int = 16):
     """Shared rung scaffold: build + start the cluster with the GC tuning
     every rung needs (defer gen-2 cascades during bring-up, then freeze the
     post-bring-up heap out of the collector — a single gen-2 pass over the
@@ -462,7 +482,8 @@ async def _started_cluster(num_groups: int, batched: bool,
                                batched=batched, transport=transport,
                                sm=sm, datastream=datastream,
                                hibernate=hibernate,
-                               mesh_devices=mesh_devices)
+                               mesh_devices=mesh_devices,
+                               trace=trace, trace_sample=trace_sample)
         await cluster.start()
         cluster.servers[0].seal_heap()
         gc.enable()
@@ -479,16 +500,22 @@ async def run_bench(num_groups: int, writes_per_group: int,
                     sm: str = "counter", num_servers: int = 3,
                     hibernate: bool = False, active_groups=None,
                     settle_s: float = 0.0, mesh_devices: int = 0,
-                    teardown: bool = True) -> dict:
+                    teardown: bool = True, trace: bool = False,
+                    trace_sample: int = 16,
+                    trace_out: "str | None" = None) -> dict:
     """One ladder rung: build the ``num_servers``-server cluster, elect,
     warm up, measure, tear down.  ``teardown=False`` skips the graceful
     close: a measurement child that exits right after reporting has no
     business spending minutes unwinding 50k divisions (measured: the
     5-peer 10240 rung's close ran LONGER than its measurement; the OS
-    reclaims an exiting process instantly)."""
+    reclaims an exiting process instantly).  ``trace`` enables host-path
+    tracing (ratis_tpu.trace) over the measured window and attaches the
+    ``host_path_decomposition`` block; ``trace_out`` additionally writes
+    the Chrome trace-event JSON (Perfetto-loadable) to that path."""
     cm = _started_cluster(num_groups, batched, transport=transport,
                           sm=sm, num_servers=num_servers,
-                          hibernate=hibernate, mesh_devices=mesh_devices)
+                          hibernate=hibernate, mesh_devices=mesh_devices,
+                          trace=trace, trace_sample=trace_sample)
     cluster = await cm.__aenter__()
     try:
         if hibernate and settle_s:
@@ -504,9 +531,29 @@ async def run_bench(num_groups: int, writes_per_group: int,
             await cluster.run_load(warmup_writes, concurrency,
                                    message_factory=mf,
                                    active_groups=active_groups)
+        if trace:
+            # decompose the MEASURED window only, not warmup/bring-up
+            from ratis_tpu.trace import get_tracer
+            get_tracer().reset()
         result = await cluster.run_load(writes_per_group, concurrency,
                                         message_factory=mf,
                                         active_groups=active_groups)
+        if trace:
+            from ratis_tpu.trace import get_tracer
+            from ratis_tpu.trace.export import (host_path_decomposition,
+                                                write_chrome_trace)
+            records = get_tracer().snapshot()
+            result["host_path_decomposition"] = \
+                host_path_decomposition(records)
+            dropped = get_tracer().stage_dropped()
+            if dropped:
+                # never a silent cap: wraparound means the table covers the
+                # tail of the window, not all of it
+                result["host_path_decomposition"]["rings_dropped"] = dropped
+            if trace_out:
+                import os
+                write_chrome_trace(trace_out, records)
+                result["trace_out"] = os.path.abspath(trace_out)
         engines = [s.engine for s in cluster.servers]
         result["batched_dispatches"] = sum(
             e.metrics["batched_dispatches"] for e in engines)

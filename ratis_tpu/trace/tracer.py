@@ -1,0 +1,253 @@
+"""Host-path tracing: request->commit spans over the five-layer request path.
+
+No reference analog — the reference leans on JVM profilers; here the host
+runtime is a single asyncio loop and the question every perf round asks is
+"which host-side stage eats the commit's wall-clock?" (VERDICT r5: the
+1025 commits/s headline had no artifact decomposing msgpack / socket /
+division-append / engine-dispatch cost).  This module answers it with
+always-available, low-overhead structured spans:
+
+- A :class:`TraceContext` is just an integer trace id minted at the client
+  (``Tracer.begin_trace``), carried on :class:`RaftClientRequest` (wire
+  field ``tr``) through the transport codec, server routing, the division
+  write path, and apply — every stage the request crosses records a span
+  against the same id.
+- Span records are written to fixed-size per-stage ring buffers
+  (:class:`SpanRing`): a pre-allocated int64 array, one row assignment per
+  record — no allocation on the hot path, bounded memory, and a high-rate
+  stage (codec) can never evict a low-rate one (client spans).
+- Sampling (``raft.tpu.trace.sample-every``) bounds the recording rate;
+  with tracing disabled (the default) every instrumentation site is a
+  single attribute check.
+
+Aggregation/export (Chrome trace-event JSON for Perfetto, and the
+per-stage percentile decomposition table) lives in
+:mod:`ratis_tpu.trace.export`.
+
+The runtime is single-event-loop end to end, so one process-wide tracer
+(``TRACER`` / :func:`get_tracer`) serves every co-hosted server and the
+in-process clients; cross-process propagation rides the wire field.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import time
+
+import numpy as np
+
+# Transport ingress timestamp for the in-flight request: the transport sets
+# it just before handing off to the server handler, and the handler's route
+# span starts there — so the task-scheduling hop between ingress and the
+# handler's first instruction is ATTRIBUTED (it is real latency), not lost
+# to the coverage residual.  A ContextVar propagates into the handler task
+# (task creation copies the caller's context); single-use — the reader
+# clears it.
+INGRESS_NS: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "ratis_trace_ingress_ns", default=0)
+
+# Stage ids.  The SERVER-side stages route/txn_start/append/replicate/apply
+# TILE the request's server wall-clock (each starts where the previous
+# ends), so their per-trace sum is directly comparable to the client span.
+# CLIENT / WIRE / ENGINE overlap other stages (marked in export).
+STAGE_CLIENT = 0      # client.send — full client-observed request wall
+STAGE_ENCODE = 1      # codec.encode — msgpack encode (request or server rpc)
+STAGE_DECODE = 2      # codec.decode — msgpack decode
+STAGE_WIRE = 3        # wire.rtt — transport send + reply (overlaps server)
+STAGE_ROUTE = 4       # server.route — handler entry -> division submit
+STAGE_TXN = 5         # server.txn_start — SM start/pre-append hooks
+STAGE_APPEND = 6      # server.append — leader log append (in-memory)
+STAGE_REPLICATE = 7   # server.replicate — append done -> apply starts
+                      # (quorum wait + apply-queue wait)
+STAGE_APPLY = 8       # server.apply — state-machine apply
+STAGE_REPLY = 9       # server.reply — apply done -> write handler resumes
+                      # (reply-future resolution + event-loop scheduling)
+STAGE_RESPOND = 10    # server.respond — server handler done -> reply handed
+                      # back to the transport / written to the socket
+STAGE_ENGINE = 11     # engine.dispatch — one quorum-engine tick dispatch
+NUM_STAGES = 12
+
+STAGE_NAMES = (
+    "client.send", "codec.encode", "codec.decode", "wire.rtt",
+    "server.route", "server.txn_start", "server.append",
+    "server.replicate", "server.apply", "server.reply", "server.respond",
+    "engine.dispatch",
+)
+
+# Stages whose durations tile the per-request path (no mutual overlap):
+# these are the ones the decomposition's coverage fraction sums.
+TILING_STAGES = (STAGE_ENCODE, STAGE_DECODE, STAGE_ROUTE, STAGE_TXN,
+                 STAGE_APPEND, STAGE_REPLICATE, STAGE_APPLY, STAGE_REPLY,
+                 STAGE_RESPOND)
+
+
+class SpanRing:
+    """Fixed-size span ring for ONE stage.
+
+    Records are rows of a pre-allocated ``[capacity, 4]`` int64 array
+    (trace_id, t0_ns, dur_ns, tag) — recording is one row assignment, no
+    allocation, and wraparound overwrites the oldest record."""
+
+    COLS = 4
+
+    __slots__ = ("capacity", "_buf", "_n")
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._buf = np.zeros((self.capacity, self.COLS), np.int64)
+        self._n = 0
+
+    def record(self, trace_id: int, t0_ns: int, t1_ns: int,
+               tag: int = 0) -> None:
+        row = self._buf[self._n % self.capacity]
+        row[0] = trace_id
+        row[1] = t0_ns
+        row[2] = t1_ns - t0_ns
+        row[3] = tag
+        self._n += 1
+
+    @property
+    def count(self) -> int:
+        """Records currently held (<= capacity)."""
+        return min(self._n, self.capacity)
+
+    @property
+    def recorded(self) -> int:
+        """Records ever written (wraparound keeps only the last capacity)."""
+        return self._n
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._n - self.capacity)
+
+    def rows(self) -> np.ndarray:
+        """Held records, oldest first, as an [n, 4] array copy."""
+        if self._n <= self.capacity:
+            return self._buf[:self._n].copy()
+        i = self._n % self.capacity
+        return np.concatenate([self._buf[i:], self._buf[:i]])
+
+    def clear(self) -> None:
+        self._n = 0
+
+
+class Tracer:
+    """Process-wide span recorder.  Disabled (the default) it costs one
+    attribute check per instrumentation site; enabled, each Nth request
+    (``sample_every``) gets a trace id and its stages record spans."""
+
+    DEFAULT_RING_SIZE = 4096
+
+    def __init__(self):
+        self.enabled = False
+        self.sample_every = 1
+        self.ring_size = self.DEFAULT_RING_SIZE
+        self._rings: list[SpanRing] = [SpanRing(1) for _ in range(NUM_STAGES)]
+        self._ids = itertools.count(1)
+        self._req_tick = 0
+        self._proc_tick = 0
+        # trace_id -> server-handler-done ns (mark_egress/pop_egress): lets
+        # the TRANSPORT close the respond span across the task boundary the
+        # handler's return crosses (a ContextVar cannot flow back out of
+        # the handler task — task creation copies the context one way).
+        self._egress: dict[int, int] = {}
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, enabled: bool = True, sample_every: int = 1,
+                  ring_size: int = DEFAULT_RING_SIZE) -> None:
+        """(Re)configure; allocates fresh rings (existing records drop)."""
+        self.sample_every = max(1, int(sample_every))
+        self.ring_size = max(1, int(ring_size))
+        self._rings = [SpanRing(self.ring_size) for _ in range(NUM_STAGES)]
+        self._req_tick = 0
+        self._proc_tick = 0
+        self._egress = {}
+        self.enabled = bool(enabled)
+
+    def reset(self) -> None:
+        """Drop recorded spans; keep configuration."""
+        for ring in self._rings:
+            ring.clear()
+        self._egress.clear()
+
+    # -- hot path ------------------------------------------------------------
+
+    @staticmethod
+    def now() -> int:
+        return time.monotonic_ns()
+
+    def begin_trace(self) -> int:
+        """Mint a trace id for a new client request, or 0 when this request
+        is not sampled (callers skip every record for id 0)."""
+        if not self.enabled:
+            return 0
+        self._req_tick += 1
+        if self._req_tick % self.sample_every:
+            return 0
+        return next(self._ids)
+
+    def sample(self) -> bool:
+        """Sampling decision for PROCESS-level stages (codec on server
+        RPCs, engine dispatch) that have no request trace id."""
+        if not self.enabled:
+            return False
+        self._proc_tick += 1
+        return self._proc_tick % self.sample_every == 0
+
+    def record(self, trace_id: int, stage: int, t0_ns: int, t1_ns: int,
+               tag: int = 0) -> None:
+        if not self.enabled:
+            return
+        self._rings[stage].record(trace_id, t0_ns, t1_ns, tag)
+
+    def mark_egress(self, trace_id: int) -> None:
+        """Server handler is done with this request NOW; the transport pops
+        the mark to record the respond span (serialize + hand-back/socket
+        write).  Bounded: a transport path that never pops (e.g. a direct
+        division submit) must not leak entries forever."""
+        if not self.enabled or not trace_id:
+            return
+        if len(self._egress) > 8192:
+            self._egress.clear()
+        self._egress[trace_id] = time.monotonic_ns()
+
+    def pop_egress(self, trace_id: int) -> int:
+        if not self._egress:
+            return 0
+        return self._egress.pop(trace_id, 0)
+
+    # -- aggregation ---------------------------------------------------------
+
+    def snapshot(self) -> list[tuple[int, int, int, int, int]]:
+        """Every held record as (trace_id, stage, t0_ns, dur_ns, tag)."""
+        out: list[tuple[int, int, int, int, int]] = []
+        for stage, ring in enumerate(self._rings):
+            for tid, t0, dur, tag in ring.rows().tolist():
+                out.append((tid, stage, t0, dur, tag))
+        return out
+
+    def stage_dropped(self) -> dict[str, int]:
+        return {STAGE_NAMES[i]: r.dropped
+                for i, r in enumerate(self._rings) if r.dropped}
+
+
+TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return TRACER
+
+
+def configure_from_properties(p) -> None:
+    """Enable the process tracer when ``raft.tpu.trace.enabled`` is set.
+    Never disables: co-hosted servers share ONE tracer, and a second
+    server built without the key must not silence the first's tracing."""
+    if p is None:
+        return
+    from ratis_tpu.conf.keys import RaftServerConfigKeys
+    K = RaftServerConfigKeys.Trace
+    if K.enabled(p) and not TRACER.enabled:
+        TRACER.configure(enabled=True, sample_every=K.sample_every(p),
+                         ring_size=K.ring_size(p))

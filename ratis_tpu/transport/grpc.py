@@ -34,6 +34,8 @@ from ratis_tpu.protocol.ids import RaftPeerId
 from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope,
                                         decode_rpc, encode_rpc)
 from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.trace.tracer import (INGRESS_NS, STAGE_DECODE, STAGE_ENCODE,
+                                    STAGE_RESPOND, STAGE_WIRE, TRACER)
 from ratis_tpu.transport.base import (ClientRequestHandler, ClientTransport,
                                       ServerRpcHandler, ServerTransport,
                                       TransportFactory)
@@ -491,8 +493,19 @@ class GrpcServerTransport(ServerTransport):
         grpc.aio's per-unary-call overhead was going at 1024 groups."""
 
         async def dispatch(payload: bytes) -> bytes:
+            t0 = TRACER.now() if TRACER.enabled else 0
             request = RaftClientRequest.from_bytes(payload)
-            return (await self.client_handler(request)).to_bytes()
+            if t0 and request.trace_id:
+                now = TRACER.now()
+                TRACER.record(request.trace_id, STAGE_DECODE, t0,
+                              now, tag=len(payload))
+                INGRESS_NS.set(now)  # route span starts post-decode
+            reply_bytes = (await self.client_handler(request)).to_bytes()
+            egress = TRACER.pop_egress(request.trace_id)
+            if egress:
+                TRACER.record(request.trace_id, STAGE_RESPOND, egress,
+                              TRACER.now(), tag=len(reply_bytes))
+            return reply_bytes
 
         async for item in self._serve_stream(request_iterator, dispatch):
             yield item
@@ -770,8 +783,18 @@ class GrpcClientTransport(ClientTransport):
                 lambda: self._pool.stream(peer_address,
                                           _REQUEST_STREAM_METHOD)())
             self._streams[peer_address] = stream
+        tid = request.trace_id if TRACER.enabled else 0
         try:
-            reply_bytes = await stream.send(request.to_bytes(), timeout)
+            t0 = TRACER.now() if tid else 0
+            payload = request.to_bytes()
+            if tid:
+                TRACER.record(tid, STAGE_ENCODE, t0, TRACER.now(),
+                              tag=len(payload))
+                t0 = TRACER.now()
+            reply_bytes = await stream.send(payload, timeout)
+            if tid:
+                TRACER.record(tid, STAGE_WIRE, t0, TRACER.now(),
+                              tag=len(reply_bytes))
         except (RaftException, TimeoutIOException):
             raise
         except asyncio.TimeoutError:

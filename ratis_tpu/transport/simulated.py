@@ -20,6 +20,8 @@ from typing import Optional
 from ratis_tpu.protocol.exceptions import TimeoutIOException
 from ratis_tpu.protocol.ids import RaftPeerId
 from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.trace.tracer import (INGRESS_NS, STAGE_RESPOND, STAGE_WIRE,
+                                    TRACER)
 from ratis_tpu.transport.base import (ClientRequestHandler, ClientTransport,
                                       ServerRpcHandler, ServerTransport,
                                       TransportFactory)
@@ -130,8 +132,27 @@ class SimulatedNetwork:
         if self.is_blocked(None, target.peer_id):
             raise TimeoutIOException(f"simulated: client->{target.peer_id} blocked")
         await self._hop_delay()
-        return await asyncio.wait_for(target.client_handler(request),
-                                      self.client_request_timeout_s)
+        tid = request.trace_id if TRACER.enabled else 0
+        if not tid:
+            return await asyncio.wait_for(target.client_handler(request),
+                                          self.client_request_timeout_s)
+        # wire span over a direct function call: ~the server wall — the
+        # same overlap shape the socket transports record, so a trace read
+        # in Perfetto has the hop lane on every transport
+        t0 = TRACER.now()
+        INGRESS_NS.set(t0)  # wait_for's task copies this context: the
+        # handler's route span starts at ingress, not at task start
+        try:
+            return await asyncio.wait_for(target.client_handler(request),
+                                          self.client_request_timeout_s)
+        finally:
+            now = TRACER.now()
+            egress = TRACER.pop_egress(tid)
+            if egress:
+                # handler done -> this coroutine resumed: the hand-back
+                # task-switch hop (the sim's whole "reply write" cost)
+                TRACER.record(tid, STAGE_RESPOND, egress, now)
+            TRACER.record(tid, STAGE_WIRE, t0, now)
 
 
 class SimulatedServerTransport(ServerTransport):

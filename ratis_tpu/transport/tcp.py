@@ -27,6 +27,8 @@ from ratis_tpu.protocol.exceptions import (RaftException, TimeoutIOException,
 from ratis_tpu.protocol.ids import RaftPeerId
 from ratis_tpu.protocol.raftrpc import decode_rpc, encode_rpc
 from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.trace.tracer import (INGRESS_NS, STAGE_DECODE, STAGE_ENCODE,
+                                    STAGE_RESPOND, STAGE_WIRE, TRACER)
 from ratis_tpu.transport.base import (ClientRequestHandler, ClientTransport,
                                       ServerRpcHandler, ServerTransport,
                                       TransportFactory)
@@ -292,13 +294,22 @@ class TcpServerTransport(ServerTransport):
     async def _serve_one(self, frame, writer: asyncio.StreamWriter,
                          send_lock: asyncio.Lock) -> None:
         call_seq, kind, body = frame
+        trace_tid = trace_egress = 0
         try:
             if kind == KIND_SERVER_RPC:
                 reply = await self.server_handler(decode_rpc(body))
                 out_kind, out = KIND_REPLY, encode_rpc(reply)
             elif kind == KIND_CLIENT_REQUEST:
-                reply = await self.client_handler(
-                    RaftClientRequest.from_bytes(body))
+                t0 = TRACER.now() if TRACER.enabled else 0
+                request = RaftClientRequest.from_bytes(body)
+                if t0 and request.trace_id:
+                    now = TRACER.now()
+                    TRACER.record(request.trace_id, STAGE_DECODE, t0,
+                                  now, tag=len(body))
+                    INGRESS_NS.set(now)  # route span starts post-decode
+                reply = await self.client_handler(request)
+                trace_tid = request.trace_id
+                trace_egress = TRACER.pop_egress(trace_tid)
                 out_kind, out = KIND_REPLY, reply.to_bytes()
             else:
                 raise RaftException(f"unexpected frame kind {kind}")
@@ -314,6 +325,11 @@ class TcpServerTransport(ServerTransport):
             async with send_lock:
                 writer.write(_encode_frame(call_seq, out_kind, out))
                 await writer.drain()
+            if trace_egress:
+                # handler done -> reply serialized, framed, and drained to
+                # the socket: the real "reply write" cost on this transport
+                TRACER.record(trace_tid, STAGE_RESPOND, trace_egress,
+                              TRACER.now(), tag=len(out))
         except (ConnectionError, OSError):
             pass
 
@@ -365,10 +381,22 @@ class TcpClientTransport(ClientTransport):
                            request: RaftClientRequest) -> RaftClientReply:
         timeout = (request.timeout_ms / 1000.0 if request.timeout_ms > 0
                    else self.request_timeout_s)
+        tid = request.trace_id if TRACER.enabled else 0
         try:
             conn = await self._pool.get(peer_address)
-            kind, body = await conn.call(KIND_CLIENT_REQUEST,
-                                         request.to_bytes(), timeout)
+            t0 = TRACER.now() if tid else 0
+            payload = request.to_bytes()
+            if tid:
+                TRACER.record(tid, STAGE_ENCODE, t0, TRACER.now(),
+                              tag=len(payload))
+                t0 = TRACER.now()
+            kind, body = await conn.call(KIND_CLIENT_REQUEST, payload,
+                                         timeout)
+            if tid:
+                # socket write + server + reply read: overlaps the server
+                # stages — the wire share is this minus the server tiling
+                TRACER.record(tid, STAGE_WIRE, t0, TRACER.now(),
+                              tag=len(body))
         except (ConnectionError, OSError) as e:
             raise TimeoutIOException(f"client->{peer_address}: {e}") from None
         if kind == KIND_ERROR:

@@ -75,6 +75,28 @@ def test_bootstrap_refuses_non_voting_member():
                          num_listeners=1)
 
 
+def test_bootstrap_refuses_non_appointee():
+    """Double-appointment defense: only the configuration's deterministic
+    appointee (highest priority, then lowest peer id) may bootstrap — a
+    second appointee on the same fresh group fails CLOSED instead of
+    becoming a second term-1 leader."""
+    async def body(cluster: MiniCluster):
+        divisions = {str(d.member_id.peer_id): d for d in cluster.divisions()}
+        appointee = divisions["s0"]  # lowest peer id, equal priorities
+        for name, d in divisions.items():
+            if name == "s0":
+                continue
+            with pytest.raises(RaftException, match="appointee"):
+                await d.bootstrap_as_leader()
+            assert d.is_follower() and d.state.current_term == 0
+        # the legitimate appointee still bootstraps and serves
+        await appointee.bootstrap_as_leader()
+        assert appointee.is_leader()
+        assert (await cluster.send_write()).success
+
+    run_with_new_cluster(3, body, properties=_quiet_properties())
+
+
 def test_bootstrap_survives_batched_engine_mode():
     async def body(cluster: MiniCluster):
         d = next(iter(cluster.servers.values())) \

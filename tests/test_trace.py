@@ -1,0 +1,193 @@
+"""Host-path tracing subsystem (ratis_tpu.trace): span propagation across
+the simulated transport end to end, ring-buffer wraparound, disabled-mode
+zero cost, decomposition coverage + Perfetto export validity, and the
+traced-vs-untraced overhead guard."""
+
+import asyncio
+import json
+
+import pytest
+
+from minicluster import MiniCluster, fast_properties, run_with_new_cluster
+from ratis_tpu.trace import get_tracer
+from ratis_tpu.trace.export import (host_path_decomposition, to_chrome_trace,
+                                    write_chrome_trace)
+from ratis_tpu.trace.tracer import (STAGE_APPEND, STAGE_APPLY, STAGE_CLIENT,
+                                    STAGE_NAMES, STAGE_REPLICATE, STAGE_REPLY,
+                                    STAGE_ROUTE, STAGE_TXN, SpanRing)
+
+
+@pytest.fixture(autouse=True)
+def _tracer_sandbox():
+    """Tests share ONE process-wide tracer: restore the disabled default so
+    a tracing test never bleeds spans (or enablement) into its neighbors."""
+    tracer = get_tracer()
+    yield
+    tracer.configure(enabled=False)
+
+
+# --------------------------------------------------------------- ring buffer
+
+def test_ring_wraparound_keeps_latest_records():
+    ring = SpanRing(8)
+    for i in range(20):
+        ring.record(trace_id=i, t0_ns=i * 100, t1_ns=i * 100 + 10, tag=i)
+    assert ring.count == 8
+    assert ring.recorded == 20
+    assert ring.dropped == 12
+    rows = ring.rows()
+    # oldest-first snapshot of the LAST capacity records (12..19)
+    assert [r[0] for r in rows.tolist()] == list(range(12, 20))
+    assert all(r[2] == 10 for r in rows.tolist())  # durations survive wrap
+
+
+def test_tracer_sampling_every_n():
+    tracer = get_tracer()
+    tracer.configure(enabled=True, sample_every=4, ring_size=64)
+    ids = [tracer.begin_trace() for _ in range(16)]
+    assert sum(1 for i in ids if i) == 4  # one in four sampled
+    assert len({i for i in ids if i}) == 4  # sampled ids are distinct
+
+
+# ------------------------------------------------------- disabled-mode cost
+
+def test_disabled_tracer_records_nothing():
+    tracer = get_tracer()
+    tracer.configure(enabled=False)
+
+    async def body(cluster: MiniCluster):
+        for _ in range(4):
+            assert (await cluster.send_write()).success
+
+    run_with_new_cluster(3, body, properties=fast_properties())
+    assert tracer.snapshot() == []
+    assert tracer.begin_trace() == 0
+
+
+# -------------------------------------------------- end-to-end propagation
+
+def test_span_propagation_sim_transport_end_to_end():
+    """Client send -> leader append -> commit -> apply all share ONE trace
+    id, recorded through the full RaftClient stack over the simulated
+    transport."""
+    tracer = get_tracer()
+    tracer.configure(enabled=True, sample_every=1, ring_size=1024)
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        client = cluster.new_client()
+        try:
+            reply = await client.io().send(b"INCREMENT")
+            assert reply.success
+        finally:
+            await client.close()
+
+    run_with_new_cluster(3, body, properties=fast_properties())
+
+    by_stage: dict[int, set[int]] = {}
+    for tid, stage, _t0, _dur, _tag in tracer.snapshot():
+        if tid:
+            by_stage.setdefault(stage, set()).add(tid)
+    client_ids = by_stage.get(STAGE_CLIENT, set())
+    assert client_ids, "no client span recorded"
+    # at least one request crossed every layer under a single id
+    full_path = (client_ids & by_stage.get(STAGE_ROUTE, set())
+                 & by_stage.get(STAGE_TXN, set())
+                 & by_stage.get(STAGE_APPEND, set())
+                 & by_stage.get(STAGE_REPLICATE, set())
+                 & by_stage.get(STAGE_APPLY, set())
+                 & by_stage.get(STAGE_REPLY, set()))
+    assert full_path, f"no trace id crossed all stages: {by_stage}"
+
+
+def test_trace_id_rides_the_wire_encoding():
+    from ratis_tpu.protocol.ids import ClientId, RaftGroupId, RaftPeerId
+    from ratis_tpu.protocol.message import Message
+    from ratis_tpu.protocol.requests import RaftClientRequest
+    req = RaftClientRequest(ClientId.random_id(), RaftPeerId.value_of("s0"),
+                            RaftGroupId.random_id(), 7,
+                            Message(b"x"), trace_id=12345)
+    assert RaftClientRequest.from_bytes(req.to_bytes()).trace_id == 12345
+    # untraced requests pay zero wire bytes for the field
+    bare = RaftClientRequest(req.client_id, req.server_id, req.group_id, 8,
+                             Message(b"x"))
+    assert b"tr" not in bare.to_bytes() or \
+        RaftClientRequest.from_bytes(bare.to_bytes()).trace_id == 0
+
+
+# ---------------------------------------- decomposition + Perfetto export
+
+def test_decomposition_coverage_and_perfetto_export(tmp_path):
+    """A sim-transport bench rung with tracing on: the per-stage totals
+    account for >= 80% of the client-observed wall-clock, and the Chrome
+    trace-event export is valid JSON with >= 5 distinct stage names."""
+    from ratis_tpu.tools.bench_cluster import run_bench
+    tracer = get_tracer()
+    tracer.configure(enabled=False)  # run_bench's properties re-enable it
+    out_path = str(tmp_path / "trace.json")
+
+    async def main():
+        return await run_bench(4, 16, batched=False, concurrency=8,
+                               transport="sim", warmup_writes=1,
+                               trace=True, trace_sample=1,
+                               trace_out=out_path)
+
+    result = asyncio.run(main())
+    decomp = result["host_path_decomposition"]
+    assert decomp["traced_requests"] > 0
+    assert decomp["coverage"] >= 0.8, decomp
+    # the tiling stages are all present in the table
+    for name in ("server.route", "server.txn_start", "server.append",
+                 "server.replicate", "server.apply", "server.reply",
+                 "server.respond"):
+        assert name in decomp["stages"], decomp["stages"].keys()
+    # non-overlap sanity: covered never exceeds the measured wall
+    assert decomp["covered_ms_total"] <= decomp["wall_ms_total"] * 1.001
+
+    with open(out_path) as f:
+        chrome = json.load(f)  # valid JSON or this raises
+    events = chrome["traceEvents"]
+    assert len(events) > 0
+    names = {e["name"] for e in events}
+    assert len(names) >= 5, names
+    assert names <= set(STAGE_NAMES)
+    for e in events[:50]:
+        assert e["ph"] == "X" and e["dur"] > 0 and "ts" in e
+
+
+def test_export_helpers_on_synthetic_records():
+    records = [
+        (1, STAGE_CLIENT, 1000, 1000, 0),
+        (1, STAGE_APPEND, 1100, 200, 0),
+        (1, STAGE_REPLICATE, 1300, 500, 0),
+        (1, STAGE_APPLY, 1800, 100, 0),
+    ]
+    d = host_path_decomposition(records)
+    assert d["traced_requests"] == 1
+    assert d["coverage"] == 0.8  # (200+500+100)/1000
+    chrome = to_chrome_trace(records)
+    assert len(chrome["traceEvents"]) == 4
+    assert json.loads(json.dumps(chrome)) == chrome
+
+
+# ------------------------------------------------------------ overhead guard
+
+def test_tracing_overhead_within_tolerance():
+    """Traced (sample-every=4) vs untraced throughput on the same small sim
+    rung.  The bound is deliberately loose (50%) — the point is catching a
+    pathological regression (e.g. tracing work on the untraced path), not
+    benchmarking; single-trial small rungs on shared CI scatter widely."""
+    from ratis_tpu.tools.bench_cluster import run_bench
+    tracer = get_tracer()
+    tracer.configure(enabled=False)
+
+    async def rung(trace: bool):
+        return await run_bench(2, 48, batched=False, concurrency=16,
+                               transport="sim", warmup_writes=4,
+                               trace=trace, trace_sample=4)
+
+    untraced = asyncio.run(rung(False))
+    tracer.configure(enabled=False)  # fresh state for the traced rung
+    traced = asyncio.run(rung(True))
+    assert traced["commits_per_sec"] >= untraced["commits_per_sec"] * 0.5, \
+        (traced["commits_per_sec"], untraced["commits_per_sec"])
