@@ -378,12 +378,14 @@ def _run_trials(spec: str, n: int,
 def main() -> None:
     # Simulated-transport ladder (secondary): host-runtime scaling shape.
     # Writes are scaled so every rung measures a comparable steady-state
-    # window (~8k commits) instead of a burst.
+    # window (~8k commits) instead of a burst.  The 10240 rung runs TWO
+    # trials: it is the mesh rung's comparison partner (VERDICT r5 next-
+    # round #7 — the pair must carry trials+spread, not single draws).
     ladder: dict[int, list[dict]] = {}
     for groups, writes, conc, trials in ((1, 256, 32, 2),
                                          (64, 128, 128, 2),
                                          (1024, 8, 128, TRIALS),
-                                         (10_240, 2, 128, 1)):
+                                         (10_240, 2, 128, 2)):
         if groups in ladder:
             continue
         spec = json.dumps({"groups": groups, "writes": writes,
@@ -394,34 +396,53 @@ def main() -> None:
                            "warmup": 0 if groups > 4096 else 1})
         ladder[groups] = _run_trials(spec, trials, timeout_s=1800.0)
 
+    # Mesh rung, back-to-back with the sim 10240 trials above (same
+    # machine state, trials+spread on both sides): the sharded resident
+    # engine (8 virtual CPU devices) vs the single-device engine.
+    mesh_trials = []
+    mesh_spec = json.dumps(
+        {"groups": 10_240, "writes": 2, "batched": True,
+         "concurrency": 128, "transport": "sim", "warmup": 0, "mesh": 8})
+    try:
+        mesh_trials = _run_trials(mesh_spec, 2, timeout_s=1800.0)
+    except RuntimeError:
+        mesh_trials = []
+
     # NORTH STAR (BASELINE config 3's true shape): 5-peer x 10240 groups
     # over REAL TCP sockets, batched vs the reference's scalar cost shape.
-    # Appointed-leader bootstrap + gc discipline + bulk chunking +
-    # confirmed-contact heartbeats brought bring-up from >29min (r4
-    # boundary) to ~30-40s.
+    # Traced: the rung carries its own per-stage host-path decomposition,
+    # so the residual after the round-6 wire work is quantified IN the
+    # artifact (VERDICT r5 next-round #1b).
     peer5 = _run_child(["--e2e-child", json.dumps(
         {"groups": 10_240, "writes": 2, "batched": True,
          "concurrency": 128, "transport": "tcp", "peers": 5,
-         "warmup": 0})], timeout_s=1800.0)
+         "warmup": 0, "trace": True, "trace_sample": 32})],
+        timeout_s=1800.0)
     peer5_scalar = _run_child(["--e2e-child", json.dumps(
         {"groups": 10_240, "writes": 2, "batched": False,
          "concurrency": 128, "transport": "tcp", "peers": 5,
          "warmup": 0})], timeout_s=1800.0, allow_dnf=True)
+    # The same north-star pair over gRPC — the stack the ≥10x target
+    # names (ref:ratis-grpc/.../server/GrpcLogAppender.java:70).  Either
+    # side may DNF at this scale; recorded honestly (a DNF scalar baseline
+    # at the target shape IS the structural result).
+    peer5_grpc = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 2, "batched": True,
+         "concurrency": 128, "transport": "grpc", "peers": 5,
+         "warmup": 0})], timeout_s=1500.0, allow_dnf=True)
+    peer5_grpc_scalar = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 2, "batched": False,
+         "concurrency": 128, "transport": "grpc", "peers": 5,
+         "warmup": 0})], timeout_s=1500.0, allow_dnf=True)
 
     # Config 5 probe: the 7-peer shape at reduced group count, plus the
     # engine capacity at the full 100k-group count (kernel child below).
+    # Traced: the >1s p99 of r5 needed decomposing (VERDICT weak #5).
     peer7 = _run_child(["--e2e-child", json.dumps(
         {"groups": 2048, "writes": 4, "batched": True,
          "concurrency": 128, "transport": "sim", "peers": 7,
-         "warmup": 0})], timeout_s=1800.0)
-
-    # Mesh rung: the sharded resident engine (8 virtual CPU devices) vs
-    # the single-device engine at 10240 groups — SURVEY §7 hard part 1
-    # gets an e2e number, not just dryrun bit-identity.
-    mesh = _run_child(["--e2e-child", json.dumps(
-        {"groups": 10_240, "writes": 2, "batched": True,
-         "concurrency": 128, "transport": "sim", "warmup": 0,
-         "mesh": 8})], timeout_s=1800.0, allow_dnf=True)
+         "warmup": 0, "trace": True, "trace_sample": 32})],
+        timeout_s=1800.0)
 
     # HEADLINE: real localhost TCP sockets, batched vs scalar.
     tcp_spec = json.dumps({"groups": HEADLINE_GROUPS,
@@ -475,174 +496,227 @@ def main() -> None:
     kernel = _run_child(["--kernel-child"])
     kernel_100k = _run_child(["--kernel-100k-child"], timeout_s=900.0,
                              allow_dnf=True)
+    # Real-chip e2e datapoint IN the driver artifact (VERDICT next-round
+    # #9): the 1024-group rung with the engine on the default (axon/TPU)
+    # platform.  allow_dnf — the tunnel may be absent; the error lands in
+    # the artifact instead of only in docs.
+    tpu_e2e = _run_child(["--e2e-child", json.dumps(
+        {"groups": 1024, "writes": 8, "batched": True,
+         "concurrency": 128, "transport": "sim", "platform": "tpu"})],
+        timeout_s=900.0, allow_dnf=True)
+    _write_definition()
+    print(json.dumps(_summarize(
+        headline=headline, scalar=scalar, ladder=ladder,
+        mesh_trials=mesh_trials, peer5=peer5, peer5_scalar=peer5_scalar,
+        peer5_grpc=peer5_grpc, peer5_grpc_scalar=peer5_grpc_scalar,
+        peer7=peer7, sparse_hib=sparse_hib, sparse_plain=sparse_plain,
+        churn=churn, mixed=mixed, stream=stream, grpc_b=grpc_b,
+        grpc_s_1024=grpc_s_1024, grpc_s_256=grpc_s_256, kernel=kernel,
+        kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced),
+        separators=(",", ":")))
 
-    def med(trials, key):
-        return _median([t[key] for t in trials])
 
-    headline_cps = [t["commits_per_sec"] for t in headline]
-    scalar_cps = [t["commits_per_sec"] for t in scalar]
-    # The full ~1.1k-char prose definition lives in BENCH_DEFINITION.md
-    # (written fresh each run so the artifact dir always carries it): the
-    # driver tail-captures ~2000 chars of output, and inlining the prose
-    # mid-JSON once pushed the flagship number out of the capture
-    # (BENCH_r05.json parsed: null).  The JSON keeps a short pointer.
+def _write_definition() -> None:
+    """The full prose metric definition lives in BENCH_DEFINITION.md
+    (written fresh each run so the artifact dir always carries it): the
+    driver tail-captures ~2000 chars of output and the WHOLE one-line JSON
+    must parse from that window (BENCH_r05.json overflowed it and lost the
+    flagship number: parsed null) — so the line uses the compact schema
+    documented here and carries only a pointer."""
     definition = (
-        "median over %d trials at %d groups over REAL localhost TCP "
-        "sockets: batched engine + coalesced data/heartbeat path (one "
-        "AppendEnvelope / BulkHeartbeat per destination server) vs "
-        "scalar per-group engine mode + per-(group,follower) unary "
-        "RPCs (the reference's cost shape: thread-per-division commit "
-        "math, one RPC stream per group-follower pair, "
-        "GrpcLogAppender.java:343-381), same harness, same transport "
-        "(Apache Ratis publishes no numbers to compare against - "
-        "BASELINE.md); the sim_ladder secondary is the same harness "
-        "over direct function-call transport (socket costs removed); "
-        "kernel_vs_scalar_loop is the kernel batching effect in "
-        "isolation; peer5_10240 is BASELINE config 3's true shape "
-        "(5-peer x 10240 groups) run end to end over real TCP, with "
-        "vs_scalar comparing the same harness in the reference cost "
-        "shape at that exact configuration; grpc_1024 compares "
-        "both engine modes over the reference's primary transport "
-        "analog (the scalar shape completes there only on top of this "
-        "framework's storm containment - before the round-5 "
-        "confirmed-contact heartbeats and dial pacing it could not "
-        "bring up >=512 groups; scalar_dnf records whether it "
-        "completed this run); host_path_decomposition is the per-stage "
-        "request->commit wall-clock breakdown from the traced sim rung "
-        "(ratis_tpu.trace; docs/tracing.md)" % (HEADLINE_TRIALS,
-                                                HEADLINE_GROUPS))
+        "vs_baseline: median over %d trials at %d groups over REAL "
+        "localhost TCP sockets — batched engine + coalesced data/heartbeat"
+        "/wire paths (AppendEnvelope + BulkHeartbeat per destination "
+        "server; raft.tpu.tcp/grpc write coalescing; encode-once append "
+        "codec) vs scalar per-group engine mode + per-(group,follower) "
+        "unary RPCs + per-frame writes (the reference cost shape: "
+        "thread-per-division commit math, one RPC stream per "
+        "group-follower pair, GrpcLogAppender.java:343-381), same "
+        "harness, same transport (Apache Ratis publishes no comparable "
+        "numbers - BASELINE.md).\n\n"
+        "Compact-key schema of the JSON line (kept under 2000 chars so "
+        "the driver tail window parses it; asserted in "
+        "tests/test_wire_fastpath.py):\n\n"
+        "- secondary.sim_ladder: groups -> commits/s over the sim "
+        "(function-call) transport, socket costs removed.\n"
+        "- secondary.peer5_10240: BASELINE config 3's true shape (5-peer "
+        "x 10240 groups) over real TCP; commits_per_sec/p50/p99/up "
+        "(bring-up s)/scalar (same-shape reference cost shape)/vs_scalar; "
+        "wire = per-stage host-path decomposition p50s in us from the "
+        "traced rung (route/txn/append/repl/apply/reply/resp + cov = "
+        "coverage fraction; docs/tracing.md).\n"
+        "- secondary.peer5_10240_grpc: the same pair over the gRPC "
+        "transport (the stack the >=10x target names); either side may "
+        "record dnf.\n"
+        "- secondary.peer7_2048: config 5's peer shape; wire decomp as "
+        "above.\n"
+        "- secondary.mesh_10240: sharded resident engine over 8 virtual "
+        "CPU devices, run back-to-back with the sim 10240 trials: cps/"
+        "spread vs sim_cps/sim_spread.\n"
+        "- secondary.sparse: [hibernate cps, hibernate p99 ms, groups "
+        "asleep, plain cps, plain p99 ms] at 10240 hosted / 1024 "
+        "active.\n"
+        "- secondary.churn_1024: [cps, transfers ok, failed]; "
+        "mixed_1024: [cps, streams ok, stream MB/s]; stream_mb_s: "
+        "dedicated DataStream rung aggregate MB/s.\n"
+        "- secondary.grpc_1024: both engine modes over gRPC at the "
+        "headline shape; scalar completes only on top of round-5 storm "
+        "containment (scalar_dnf records this run).\n"
+        "- secondary.tpu_e2e: the 1024-group rung with the engine on the "
+        "real chip via the axon tunnel (cps, p50) or dnf + the tunnel "
+        "error.\n"
+        "- secondary.kernel: [group-updates/s at 10240x8, x vs scalar "
+        "Python loop, platform]; kernel_100k: group-updates/s at "
+        "102400x8.\n"
+        "- secondary.wire_sim: host-path decomposition of the traced "
+        "1024-group sim rung (stage p50s us + cov), the socket-free "
+        "residual.\n" % (HEADLINE_TRIALS, HEADLINE_GROUPS))
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DEFINITION.md"), "w") as f:
-            f.write("# Bench metric definitions\n\n## vs_baseline\n\n"
-                    + definition + "\n")
+            f.write("# Bench metric definitions\n\n" + definition)
     except OSError as e:
         print(f"bench: could not write BENCH_DEFINITION.md: {e}",
               file=sys.stderr, flush=True)
-    print(json.dumps({
+
+
+def _compact_decomp(block) -> dict:
+    """JSON-line-sized view of a host_path_decomposition block: per-stage
+    p50s (us, tiling stages only) + the coverage fraction."""
+    if not isinstance(block, dict) or block.get("dnf"):
+        return {"dnf": True}
+    short = (("server.route", "route"), ("server.txn_start", "txn"),
+             ("server.append", "append"), ("server.replicate", "repl"),
+             ("server.apply", "apply"), ("server.reply", "reply"),
+             ("server.respond", "resp"))
+    stages = block.get("stages", {})
+    out = {s: stages[k]["p50_us"] for k, s in short if k in stages}
+    out["cov"] = block.get("coverage", 0.0)
+    return out
+
+
+def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
+               peer5_scalar, peer5_grpc, peer5_grpc_scalar, peer7,
+               sparse_hib, sparse_plain, churn, mixed, stream, grpc_b,
+               grpc_s_1024, grpc_s_256, kernel, kernel_100k, tpu_e2e,
+               traced) -> dict:
+    """Build the one-line JSON summary.  COMPACT by contract: the whole
+    line must parse from the driver's 2000-char tail window (r5 lost its
+    flagship number to overflow), so keys are short, numbers rounded, and
+    the schema is documented in BENCH_DEFINITION.md.  The length bound is
+    asserted against a worst-case synthetic fill in
+    tests/test_wire_fastpath.py."""
+    def med(trials, key):
+        return _median([t[key] for t in trials])
+
+    def r0(x):
+        return None if x is None else round(float(x), 1)
+
+    headline_cps = [t["commits_per_sec"] for t in headline]
+    scalar_cps = [t["commits_per_sec"] for t in scalar]
+    mesh_cps = [t["commits_per_sec"] for t in mesh_trials]
+    sim10k = ladder.get(10_240, [])
+    sim10k_cps = [t["commits_per_sec"] for t in sim10k]
+    peer5_vs = (round(peer5["commits_per_sec"]
+                      / peer5_scalar["commits_per_sec"], 2)
+                if peer5_scalar.get("commits_per_sec") else None)
+    grpc5_vs = (round(peer5_grpc["commits_per_sec"]
+                      / peer5_grpc_scalar["commits_per_sec"], 2)
+                if (peer5_grpc.get("commits_per_sec")
+                    and peer5_grpc_scalar.get("commits_per_sec")) else None)
+    wf = sum(t.get("write_failures", 0)
+             for r in (headline, scalar, grpc_b, mesh_trials,
+                       *ladder.values())
+             for t in r) + sum(
+        t.get("write_failures", 0)
+        for t in (peer5, peer5_scalar, peer5_grpc, peer5_grpc_scalar,
+                  peer7, grpc_s_1024, grpc_s_256, sparse_hib, sparse_plain,
+                  churn, mixed, tpu_e2e)
+        if isinstance(t, dict))
+    return {
         "metric": "aggregate_commits_per_sec",
         "value": _median(headline_cps),
         "unit": "commits/s",
         "vs_baseline": round(_median(headline_cps) / _median(scalar_cps), 2),
-        "vs_baseline_definition": (
-            "batched engine + coalesced RPC paths vs the reference cost "
-            "shape (scalar per-group engine, per-(group,follower) unary "
-            "RPCs) on the same TCP harness; full prose: "
-            "BENCH_DEFINITION.md"),
+        "def": "BENCH_DEFINITION.md",
         "secondary": {
             "groups": HEADLINE_GROUPS,
             "trials": HEADLINE_TRIALS,
             "transport": "tcp",
             "p50_ms": med(headline, "p50_ms"),
             "p99_ms": med(headline, "p99_ms"),
-            "election_convergence_s": med(headline,
-                                          "election_convergence_s"),
-            "spread_batched": _spread(headline_cps),
-            "spread_scalar": _spread(scalar_cps),
-            "write_failures_total": sum(
-                t.get("write_failures", 0)
-                for r in (headline, scalar, grpc_b, *ladder.values())
-                for t in r) + sum(
-                t.get("write_failures", 0)
-                for t in (peer5, peer5_scalar, peer7, mesh, grpc_s_1024,
-                          grpc_s_256, sparse_hib, sparse_plain, churn,
-                          mixed)
-                if isinstance(t, dict)),
+            "spread_b": _spread(headline_cps),
+            "spread_s": _spread(scalar_cps),
+            "wf": wf,
             "scalar_mode_commits_per_sec": _median(scalar_cps),
             "peer5_10240": {
-                "transport": "tcp",
                 "commits_per_sec": peer5["commits_per_sec"],
-                "p50_ms": peer5["p50_ms"],
-                "p99_ms": peer5["p99_ms"],
-                "bringup_s": peer5["election_convergence_s"],
-                "peers": 5,
-                "scalar_commits_per_sec": peer5_scalar.get(
-                    "commits_per_sec"),
-                "scalar_p99_ms": peer5_scalar.get("p99_ms"),
+                "p50": peer5["p50_ms"], "p99": peer5["p99_ms"],
+                "up": peer5["election_convergence_s"],
+                "scalar": peer5_scalar.get("commits_per_sec"),
                 "scalar_dnf": bool(peer5_scalar.get("dnf")),
-                "vs_scalar": (
-                    round(peer5["commits_per_sec"]
-                          / peer5_scalar["commits_per_sec"], 2)
-                    if peer5_scalar.get("commits_per_sec") else None),
+                "vs_scalar": peer5_vs,
+                "wire": _compact_decomp(
+                    peer5.get("host_path_decomposition")),
             },
+            "peer5_10240_grpc": (
+                {"dnf": True,
+                 "err": str(peer5_grpc.get("reason", ""))[:60]}
+                if peer5_grpc.get("dnf") else {
+                    "commits_per_sec": peer5_grpc["commits_per_sec"],
+                    "p99": peer5_grpc["p99_ms"],
+                    "scalar": peer5_grpc_scalar.get("commits_per_sec"),
+                    "scalar_dnf": bool(peer5_grpc_scalar.get("dnf")),
+                    "vs_scalar": grpc5_vs}),
             "peer7_2048": {
-                "commits_per_sec": peer7["commits_per_sec"],
-                "p99_ms": peer7["p99_ms"],
-                "bringup_s": peer7["election_convergence_s"],
-                "peers": 7,
+                "cps": peer7["commits_per_sec"], "p99": peer7["p99_ms"],
+                "wire": _compact_decomp(
+                    peer7.get("host_path_decomposition")),
             },
             "mesh_10240": (
-                {"dnf": True} if mesh.get("dnf") else {
-                    "commits_per_sec": mesh["commits_per_sec"],
-                    "p99_ms": mesh["p99_ms"],
-                    "devices": 8}),
-            "sim_ladder": {str(g): _median([t["commits_per_sec"] for t in r])
-                           for g, r in sorted(ladder.items())},
-            "sim_ladder_p99_ms": {
-                str(g): _median([t["p99_ms"] for t in r])
+                {"dnf": True} if not mesh_cps else {
+                    "cps": _median(mesh_cps), "spread": _spread(mesh_cps),
+                    "sim_cps": _median(sim10k_cps) if sim10k_cps else None,
+                    "sim_spread": _spread(sim10k_cps)}),
+            "sim_ladder": {str(g): r0(_median(
+                [t["commits_per_sec"] for t in r]))
                 for g, r in sorted(ladder.items())},
-            "sim_ladder_convergence_s": {
-                str(g): _median([t["election_convergence_s"] for t in r])
-                for g, r in sorted(ladder.items())},
-            "sparse_10240_active_1024": {
-                "hibernate_commits_per_sec": sparse_hib["commits_per_sec"],
-                "hibernate_p99_ms": sparse_hib["p99_ms"],
-                "hibernated_groups": sparse_hib.get("hibernated_groups", 0),
-                "plain_commits_per_sec": sparse_plain["commits_per_sec"],
-                "plain_p99_ms": sparse_plain["p99_ms"],
-            },
-            "churn_1024": {
-                "commits_per_sec": churn["commits_per_sec"],
-                "p99_ms": churn["p99_ms"],
-                "transfers_ok": churn["transfers_ok"],
-                "transfers_failed": churn["transfers_failed"],
-                "transfer_failures": churn.get("transfer_failures", []),
-            },
-            "mixed_filestore_1024": {
-                "commits_per_sec": mixed["commits_per_sec"],
-                "streams_ok": mixed["streams_ok"],
-                "streams_failed": mixed.get("streams_failed", 0),
-                "stream_failures": mixed.get("stream_failures", []),
-                "stream_mb_per_s": mixed["stream_mb_per_s"],
-            },
-            "stream_throughput": {
-                "streams_ok": stream["streams_ok"],
-                "stream_mb_per_s": stream["stream_mb_per_s"],
-                "streams": stream["streams"],
-                "stream_mb": stream["stream_mb"],
-                "packet_kb": stream["packet_kb"],
-            },
+            "sparse": [sparse_hib["commits_per_sec"],
+                       sparse_hib["p99_ms"],
+                       sparse_hib.get("hibernated_groups", 0),
+                       sparse_plain["commits_per_sec"],
+                       sparse_plain["p99_ms"]],
+            "churn_1024": [churn["commits_per_sec"], churn["transfers_ok"],
+                           churn["transfers_failed"]],
+            "mixed_1024": [mixed["commits_per_sec"], mixed["streams_ok"],
+                           mixed["stream_mb_per_s"]],
+            "stream_mb_s": stream["stream_mb_per_s"],
             "grpc_1024": {
                 "batched_commits_per_sec": _median(
                     [t["commits_per_sec"] for t in grpc_b]),
-                "batched_p99_ms": _median([t["p99_ms"] for t in grpc_b]),
+                "p99": _median([t["p99_ms"] for t in grpc_b]),
                 "scalar_dnf": bool(grpc_s_1024.get("dnf")),
-                "scalar_1024_commits_per_sec": grpc_s_1024.get(
-                    "commits_per_sec"),
-                "scalar_largest_completing": {
-                    "groups": 256,
-                    "commits_per_sec": grpc_s_256.get("commits_per_sec")},
+                "scalar": grpc_s_1024.get("commits_per_sec"),
+                "s256": grpc_s_256.get("commits_per_sec"),
             },
-            "kernel_group_updates_per_sec": kernel["group_updates_per_sec"],
-            "kernel_vs_scalar_loop": kernel["vs_scalar_loop"],
-            "kernel_platform": kernel["platform"],
-            "kernel_100k": kernel_100k,
-            "host_path_decomposition": (
+            "tpu_e2e": (
+                {"dnf": True, "err": str(tpu_e2e.get(
+                    "reason", tpu_e2e.get("timeout_s", "")))[:60]}
+                if tpu_e2e.get("dnf") else
+                {"cps": tpu_e2e["commits_per_sec"],
+                 "p50": tpu_e2e["p50_ms"]}),
+            "kernel": [kernel["group_updates_per_sec"],
+                       kernel["vs_scalar_loop"], kernel["platform"]],
+            "kernel_100k": (
+                None if kernel_100k.get("dnf")
+                else kernel_100k.get("group_updates_per_sec_100k")),
+            "wire_sim": (
                 {"dnf": True} if traced.get("dnf") else {
-                    **traced.get("host_path_decomposition", {}),
-                    "commits_per_sec": traced.get("commits_per_sec"),
-                    "groups": 1024,
-                    "transport": "sim",
-                    "trace_chrome_json": traced.get("trace_out"),
-                }),
+                    **_compact_decomp(
+                        traced.get("host_path_decomposition")),
+                    "cps": traced.get("commits_per_sec")}),
         },
-        # flagship numbers REPEATED as the final keys: a capture that
-        # keeps only the line's tail still carries them, and one that
-        # keeps the head has the canonical copy up front
-        "value_tail": _median(headline_cps),
-        "vs_baseline_tail": round(
-            _median(headline_cps) / _median(scalar_cps), 2),
-    }))
+    }
 
 
 if __name__ == "__main__":

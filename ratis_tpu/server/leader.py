@@ -557,6 +557,10 @@ class LogAppender:
                 div.on_follower_heartbeat_ack(self.follower)
         elif reply.result == AppendResult.INCONSISTENCY:
             if epoch == self._epoch:
+                # observable reorder/rewind churn (ADVICE r5): the keyed
+                # gRPC stream dispatch should keep this at ~0 under load
+                m = div.server.replication.metrics
+                m["rewinds"] = m.get("rewinds", 0) + 1
                 hint = min(reply.next_index,
                            max(request.previous.index if request.previous
                                else 0, 0))

@@ -68,7 +68,7 @@ class PeerSender:
         self.coalescing = coalescing
         self.envelope_byte_limit = envelope_byte_limit
         self.metrics = metrics if metrics is not None else {
-            "envelopes": 0, "items": 0}
+            "envelopes": 0, "items": 0, "rewinds": 0}
         self._dirty: dict[object, None] = {}  # insertion-ordered appender set
         self.refs: set = set()  # registered appenders (scheduler-managed)
         self._wake = asyncio.Event()
@@ -240,8 +240,20 @@ class ReplicationScheduler:
         self.envelope_byte_limit = envelope_byte_limit
         self._senders: dict[RaftPeerId, PeerSender] = {}
         self._closed = False
-        # shared across senders: folding evidence for tests/benchmarks
-        self.metrics = {"envelopes": 0, "items": 0}
+        # shared across senders: folding evidence for tests/benchmarks;
+        # "rewinds" counts INCONSISTENCY-triggered window resets (the
+        # reorder churn the keyed-FIFO gRPC dispatch exists to prevent —
+        # ADVICE r5; incremented by LogAppender._on_reply)
+        self.metrics = {"envelopes": 0, "items": 0, "rewinds": 0}
+
+    @staticmethod
+    def codec_stats() -> dict:
+        """Snapshot of the encode-once fast path's counters
+        (protocol.raftrpc.FANOUT_STATS): how often the spliced append
+        encoder ran, how often a fan-out suffix was reused, and whether
+        anything fell back to the generic packer."""
+        from ratis_tpu.protocol.raftrpc import FANOUT_STATS
+        return dict(FANOUT_STATS)
 
     def sender_for(self, to: RaftPeerId) -> PeerSender:
         s = self._senders.get(to)

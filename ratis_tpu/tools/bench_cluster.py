@@ -137,6 +137,18 @@ def bench_properties(batched: bool, num_groups: int = 1,
         p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
         p.set(RaftServerConfigKeys.Log.Appender.COALESCING_ENABLED_KEY, "true")
         p.set(RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY, "true")
+        # Wire write coalescing (raft.tpu.*, round 6): batch pending frames
+        # into one buffered flush per connection — the per-frame
+        # write()+drain() pair was the measured top host cost of the real
+        # TCP path once consensus itself left the latency path.  100µs of
+        # latency budget is noise against ~100ms commit p50; the byte
+        # threshold flushes big batches early.  Scalar mode keeps the
+        # reference's per-frame shape (these stay 0 there).
+        from ratis_tpu.conf.keys import WireConfigKeys
+        p.set(WireConfigKeys.Tcp.FLUSH_BYTES_KEY, "128KB")
+        p.set(WireConfigKeys.Tcp.FLUSH_MICROS_KEY, "100")
+        p.set(WireConfigKeys.Grpc.FLUSH_MICROS_KEY, "100")
+        p.set(WireConfigKeys.Grpc.FLUSH_CHUNKS_KEY, "64")
         if hibernate:
             # idle-group quiescence (requires the coalesced heartbeat
             # channel): idle groups cost zero background traffic
@@ -396,7 +408,9 @@ class BenchCluster:
         (default: the counter INCREMENT).  ``active_groups`` restricts the
         load to the first N groups — the sparse multi-tenant shape where
         most hosted groups are cold."""
-        client = self.factory.new_client_transport()
+        # properties matter here: the client plane gets the same wire
+        # coalescing conf as the servers (raft.tpu.tcp/grpc flush keys)
+        client = self.factory.new_client_transport(self.properties)
         sem = asyncio.Semaphore(concurrency)
         latencies: list[float] = []
         target_groups = (self.groups if active_groups is None
@@ -558,6 +572,20 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["batched_dispatches"] = sum(
             e.metrics["batched_dispatches"] for e in engines)
         result["engine_ticks"] = sum(e.metrics["ticks"] for e in engines)
+        # wire fast-path observability: INCONSISTENCY rewinds (should be ~0
+        # with the keyed stream dispatch), encode-once reuse, gRPC framing
+        # batches — the evidence the round-6 hot-path work actually engaged
+        result["append_rewinds"] = sum(
+            s2.replication.metrics.get("rewinds", 0)
+            for s2 in cluster.servers)
+        from ratis_tpu.server.replication import ReplicationScheduler
+        result["codec"] = ReplicationScheduler.codec_stats()
+        if transport == "grpc":
+            result["grpc_dispatch"] = {
+                k: sum(s2.transport.dispatch_metrics.get(k, 0)
+                       for s2 in cluster.servers)
+                for k in ("stream_chunks", "keyed_chunks", "ordered_waits",
+                          "batched_messages", "reply_batches")}
         for reason in ("dispatch_upload", "dispatch_commit",
                        "dispatch_dirty", "dispatch_votes",
                        "dispatch_sweep", "dispatch_backlog"):
