@@ -126,6 +126,31 @@ def child_e2e(spec: str) -> None:
         _gc_log()
     if os.environ.get("RATIS_BENCH_MEMLOG"):
         _mem_log()
+    if cfg.get("mp"):
+        # multi-process cluster: each peer its own subprocess (own engine,
+        # own GC, real sockets), load generator sharded across client
+        # subprocesses — the deployment shape, not a one-GIL time-slice
+        import asyncio
+
+        from ratis_tpu.tools.bench_cluster import run_multiproc_bench
+
+        async def mp_main():
+            out = await run_multiproc_bench(
+                cfg["groups"], cfg["writes"],
+                num_servers=cfg.get("peers", 5),
+                transport=cfg.get("transport", "tcp"),
+                batched=cfg.get("batched", True),
+                loop_shards=cfg.get("shards", 1),
+                client_procs=int(cfg["mp"]),
+                concurrency=cfg.get("concurrency", 128),
+                sm=cfg.get("sm", "counter"),
+                trace=cfg.get("trace", False),
+                trace_sample=cfg.get("trace_sample", 32))
+            print("RESULT " + json.dumps(out), flush=True)
+            os._exit(0)
+
+        asyncio.run(mp_main())
+        return
     mesh = cfg.get("mesh", 0)
     if mesh:
         # must land before any jax backend init: the sharded resident
@@ -161,7 +186,9 @@ def child_e2e(spec: str) -> None:
                               teardown=False,
                               trace=cfg.get("trace", False),
                               trace_sample=cfg.get("trace_sample", 16),
-                              trace_out=cfg.get("trace_out"))
+                              trace_out=cfg.get("trace_out"),
+                              loop_shards=cfg.get("shards", 1),
+                              client_shards=cfg.get("client_shards", 1))
         print("RESULT " + json.dumps(out), flush=True)
         # measurement children skip the graceful unwind: closing 50k
         # divisions ran LONGER than the measurement itself; process exit
@@ -240,6 +267,69 @@ def child_mixed() -> None:
     async def main():
         out = await run_mixed_bench(1024, 4, streams=32,
                                     stream_bytes=256 << 10)
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
+def child_filestore5(spec: str = "{}") -> None:
+    """BASELINE config 3's ACTUAL workload at its actual shape (VERDICT
+    Missing #3): FileStore SM + concurrent DataStream writes at 5-peer x
+    10240 groups over real TCP; reports commits/s, stream MB/s, p99."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_mixed_bench
+
+    cfg = json.loads(spec) if spec else {}
+
+    async def main():
+        out = await run_mixed_bench(
+            cfg.get("groups", 10_240), cfg.get("writes", 1),
+            streams=cfg.get("streams", 16),
+            stream_bytes=cfg.get("stream_bytes", 4 << 20),
+            num_servers=cfg.get("peers", 5),
+            transport="tcp", concurrency=cfg.get("concurrency", 128),
+            loop_shards=cfg.get("shards", 1),
+            client_shards=cfg.get("client_shards", 1),
+            stream_window=32)
+        print("RESULT " + json.dumps(out), flush=True)
+        os._exit(0)  # measurement child: skip the 51200-division unwind
+
+    asyncio.run(main())
+
+
+def child_readmix() -> None:
+    """Mixed read/write rung at 1024 groups (VERDICT Missing #4):
+    linearizable lease reads at the leader, linearizable readIndex reads
+    at a follower, stale reads — alongside the write load; reports
+    reads/s (run_read_write_bench)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_read_write_bench
+
+    async def main():
+        out = await run_read_write_bench(1024, 4, concurrency=128,
+                                         transport="tcp")
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
+def child_snapcatch() -> None:
+    """InstallSnapshot-under-load rung at 1024 groups (VERDICT Missing
+    #5): snapshot+purge the leaders, wipe one server's replicas, measure
+    chunked-install catch-up while writes keep flowing
+    (run_snapshot_catchup_bench)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_snapshot_catchup_bench
+
+    async def main():
+        out = await run_snapshot_catchup_bench(1024, 4, concurrency=128,
+                                               transport="tcp")
         print("RESULT " + json.dumps(out))
 
     asyncio.run(main())
@@ -409,15 +499,33 @@ def main() -> None:
         mesh_trials = []
 
     # NORTH STAR (BASELINE config 3's true shape): 5-peer x 10240 groups
-    # over REAL TCP sockets, batched vs the reference's scalar cost shape.
-    # Traced: the rung carries its own per-stage host-path decomposition,
-    # so the residual after the round-6 wire work is quantified IN the
-    # artifact (VERDICT r5 next-round #1b).
-    peer5 = _run_child(["--e2e-child", json.dumps(
+    # over REAL TCP sockets.  Round 7 adds the DEPLOYMENT shape: each
+    # peer its own PROCESS (own engine/GC/loops), servers loop-sharded,
+    # clients split across processes — where the r6 trace located the
+    # residual (single-loop queueing).  Both shapes run back-to-back
+    # (same box state) so the delta is IN the artifact; the FLAGSHIP
+    # number is the shape the box can actually pay for: multi-process
+    # needs real cores (on a 1-2 core box, 7 processes time-slicing one
+    # CPU measure scheduler overhead, not the architecture — measured
+    # 433 vs 865 commits/s on a 1-core builder).
+    cpu = os.cpu_count() or 1
+    mp_clients = 4 if cpu >= 8 else 2
+    mp_shards = 3 if cpu >= 8 else 2
+    peer5_mp = _run_child(["--e2e-child", json.dumps(
+        {"groups": 10_240, "writes": 2, "batched": True,
+         "concurrency": 128, "transport": "tcp", "peers": 5,
+         "trace": True, "trace_sample": 32,
+         "mp": mp_clients, "shards": mp_shards})],
+        timeout_s=1800.0, allow_dnf=True)
+    peer5_sp = _run_child(["--e2e-child", json.dumps(
         {"groups": 10_240, "writes": 2, "batched": True,
          "concurrency": 128, "transport": "tcp", "peers": 5,
          "warmup": 0, "trace": True, "trace_sample": 32})],
-        timeout_s=1800.0)
+        timeout_s=1800.0, allow_dnf=True)
+    candidates = [r for r in ((peer5_mp if cpu >= 4 else None), peer5_sp,
+                              peer5_mp)
+                  if isinstance(r, dict) and r.get("commits_per_sec")]
+    peer5 = candidates[0] if candidates else peer5_sp
     peer5_scalar = _run_child(["--e2e-child", json.dumps(
         {"groups": 10_240, "writes": 2, "batched": False,
          "concurrency": 128, "transport": "tcp", "peers": 5,
@@ -493,6 +601,19 @@ def main() -> None:
     churn = _run_child(["--churn-child"], timeout_s=1200.0)
     mixed = _run_child(["--mixed-child"], timeout_s=1200.0)
     stream = _run_child(["--stream-child"], timeout_s=900.0)
+    # Config 3's ACTUAL workload at its actual shape (VERDICT Missing #3):
+    # FileStore SM + concurrent DataStream writes at 5-peer x 10240 over
+    # real TCP.  allow_dnf: a box that cannot hold 51200 filestore
+    # divisions records that honestly.
+    filestore5 = _run_child(["--filestore5-child", json.dumps(
+        {"shards": mp_shards, "client_shards": max(1, mp_clients // 2)})],
+        timeout_s=1800.0, allow_dnf=True)
+    # Mixed read/write rung (VERDICT Missing #4) and the InstallSnapshot-
+    # under-load rung (VERDICT Missing #5), both at 1024 groups over TCP.
+    readmix = _run_child(["--readmix-child"], timeout_s=1200.0,
+                         allow_dnf=True)
+    snapcatch = _run_child(["--snapcatch-child"], timeout_s=1200.0,
+                           allow_dnf=True)
     kernel = _run_child(["--kernel-child"])
     kernel_100k = _run_child(["--kernel-100k-child"], timeout_s=900.0,
                              allow_dnf=True)
@@ -507,12 +628,14 @@ def main() -> None:
     _write_definition()
     print(json.dumps(_summarize(
         headline=headline, scalar=scalar, ladder=ladder,
-        mesh_trials=mesh_trials, peer5=peer5, peer5_scalar=peer5_scalar,
+        mesh_trials=mesh_trials, peer5=peer5, peer5_sp=peer5_sp,
+        peer5_mp=peer5_mp, peer5_scalar=peer5_scalar,
         peer5_grpc=peer5_grpc, peer5_grpc_scalar=peer5_grpc_scalar,
         peer7=peer7, sparse_hib=sparse_hib, sparse_plain=sparse_plain,
         churn=churn, mixed=mixed, stream=stream, grpc_b=grpc_b,
         grpc_s_1024=grpc_s_1024, grpc_s_256=grpc_s_256, kernel=kernel,
-        kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced),
+        kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced,
+        filestore5=filestore5, readmix=readmix, snapcatch=snapcatch),
         separators=(",", ":")))
 
 
@@ -542,9 +665,23 @@ def _write_definition() -> None:
         "- secondary.peer5_10240: BASELINE config 3's true shape (5-peer "
         "x 10240 groups) over real TCP; commits_per_sec/p50/p99/up "
         "(bring-up s)/scalar (same-shape reference cost shape)/vs_scalar; "
-        "wire = per-stage host-path decomposition p50s in us from the "
-        "traced rung (route/txn/append/repl/apply/reply/resp + cov = "
-        "coverage fraction; docs/tracing.md).\n"
+        "mp = the flagship deployment shape [server processes, loop "
+        "shards per server (raft.tpu.server.loop-shards), client "
+        "processes] — each peer its own process, divisions hash-pinned "
+        "to worker event loops; sp/sp_p99 = the same rung single-process "
+        "back-to-back (the r6 shape, for the delta); wire = per-stage "
+        "host-path decomposition p50s in us from the traced rung "
+        "(route/txn/append/repl/apply/reply/resp + cov = coverage "
+        "fraction; docs/tracing.md).\n"
+        "- secondary.p5_fs: config 3's ACTUAL workload at that shape — "
+        "FileStore SM + concurrent DataStream writes at 5-peer x 10240 "
+        "over TCP: [commits/s, p99 ms, streams ok, stream MB/s].\n"
+        "- secondary.readmix: 1024-group read/write mix over TCP "
+        "(LINEARIZABLE + leader lease): [writes/s, reads/s, read p99 ms, "
+        "lease-leader reads, follower readIndex reads, stale reads].\n"
+        "- secondary.snap_1024: wipe one server's replicas at 1024 "
+        "groups, chunked snapshot install catch-up under live writes: "
+        "[catchup s, installs, commits/s during, commits/s before].\n"
         "- secondary.peer5_10240_grpc: the same pair over the gRPC "
         "transport (the stack the >=10x target names); either side may "
         "record dnf.\n"
@@ -580,9 +717,12 @@ def _write_definition() -> None:
               file=sys.stderr, flush=True)
 
 
-def _compact_decomp(block) -> dict:
+def _compact_decomp(block, client=None) -> dict:
     """JSON-line-sized view of a host_path_decomposition block: per-stage
-    p50s (us, tiling stages only) + the coverage fraction."""
+    p50s (us, tiling stages only) + the coverage fraction.  For a
+    multi-process rung, ``client`` is the CLIENT process's table — trace
+    ids do not merge across processes, so the client wall rides along as
+    ``cw`` (p50 us) instead of a per-trace coverage."""
     if not isinstance(block, dict) or block.get("dnf"):
         return {"dnf": True}
     short = (("server.route", "route"), ("server.txn_start", "txn"),
@@ -592,14 +732,19 @@ def _compact_decomp(block) -> dict:
     stages = block.get("stages", {})
     out = {s: stages[k]["p50_us"] for k, s in short if k in stages}
     out["cov"] = block.get("coverage", 0.0)
+    if isinstance(client, dict):
+        cs = client.get("stages", {}).get("client.send")
+        if cs:
+            out["cw"] = cs["p50_us"]
     return out
 
 
 def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
-               peer5_scalar, peer5_grpc, peer5_grpc_scalar, peer7,
-               sparse_hib, sparse_plain, churn, mixed, stream, grpc_b,
-               grpc_s_1024, grpc_s_256, kernel, kernel_100k, tpu_e2e,
-               traced) -> dict:
+               peer5_sp, peer5_mp, peer5_scalar, peer5_grpc,
+               peer5_grpc_scalar, peer7, sparse_hib, sparse_plain, churn,
+               mixed, stream, grpc_b, grpc_s_1024, grpc_s_256, kernel,
+               kernel_100k, tpu_e2e, traced, filestore5, readmix,
+               snapcatch) -> dict:
     """Build the one-line JSON summary.  COMPACT by contract: the whole
     line must parse from the driver's 2000-char tail window (r5 lost its
     flagship number to overflow), so keys are short, numbers rounded, and
@@ -629,9 +774,10 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                        *ladder.values())
              for t in r) + sum(
         t.get("write_failures", 0)
-        for t in (peer5, peer5_scalar, peer5_grpc, peer5_grpc_scalar,
-                  peer7, grpc_s_1024, grpc_s_256, sparse_hib, sparse_plain,
-                  churn, mixed, tpu_e2e)
+        for t in (peer5_mp, peer5_sp, peer5_scalar, peer5_grpc,
+                  peer5_grpc_scalar, peer7, grpc_s_1024, grpc_s_256,
+                  sparse_hib, sparse_plain, churn, mixed, tpu_e2e,
+                  filestore5, readmix, snapcatch)
         if isinstance(t, dict))
     return {
         "metric": "aggregate_commits_per_sec",
@@ -653,11 +799,21 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                 "commits_per_sec": peer5["commits_per_sec"],
                 "p50": peer5["p50_ms"], "p99": peer5["p99_ms"],
                 "up": peer5["election_convergence_s"],
+                # deployment shape of the flagship number: [server procs,
+                # loop shards/server, client procs]; sp/mp_cps = both
+                # shapes measured back-to-back whatever the flagship was
+                "mp": [peer5.get("mp", {}).get("server_procs", 1),
+                       peer5.get("mp", {}).get("loop_shards", 1),
+                       peer5.get("mp", {}).get("client_procs", 1)],
+                "sp": peer5_sp.get("commits_per_sec"),
+                "sp_p99": peer5_sp.get("p99_ms"),
+                "mp_cps": peer5_mp.get("commits_per_sec"),
                 "scalar": peer5_scalar.get("commits_per_sec"),
                 "scalar_dnf": bool(peer5_scalar.get("dnf")),
                 "vs_scalar": peer5_vs,
                 "wire": _compact_decomp(
-                    peer5.get("host_path_decomposition")),
+                    peer5.get("host_path_decomposition"),
+                    client=peer5.get("client_decomp")),
             },
             "peer5_10240_grpc": (
                 {"dnf": True,
@@ -691,6 +847,27 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
             "mixed_1024": [mixed["commits_per_sec"], mixed["streams_ok"],
                            mixed["stream_mb_per_s"]],
             "stream_mb_s": stream["stream_mb_per_s"],
+            # config 3's actual workload at its actual shape:
+            # [commits/s, p99 ms, streams ok, stream MB/s]
+            "p5_fs": ({"dnf": True} if filestore5.get("dnf") else
+                      [filestore5["commits_per_sec"], filestore5["p99_ms"],
+                       filestore5["streams_ok"],
+                       filestore5["stream_mb_per_s"]]),
+            # read/write mix: [writes/s, reads/s, read p99 ms,
+            # lease/followerLin/stale read counts]
+            "readmix": ({"dnf": True} if readmix.get("dnf") else
+                        [readmix["commits_per_sec"],
+                         readmix["reads_per_sec"],
+                         readmix.get("read_p99_ms"),
+                         readmix["reads_lease_leader"],
+                         readmix["reads_follower_linearizable"],
+                         readmix["reads_stale"]]),
+            # wipe-one-server catch-up: [catchup s, chunked installs,
+            # commits/s during installs, commits/s before]
+            "snap_1024": ({"dnf": True} if snapcatch.get("dnf") else
+                          [snapcatch["catchup_s"], snapcatch["installs"],
+                           snapcatch["commits_per_sec"],
+                           snapcatch["cps_before"]]),
             "grpc_1024": {
                 "batched_commits_per_sec": _median(
                     [t["commits_per_sec"] for t in grpc_b]),
@@ -732,5 +909,11 @@ if __name__ == "__main__":
         child_stream()
     elif len(sys.argv) > 1 and sys.argv[1] == "--kernel-100k-child":
         child_kernel_100k()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--filestore5-child":
+        child_filestore5(sys.argv[2] if len(sys.argv) > 2 else "{}")
+    elif len(sys.argv) > 1 and sys.argv[1] == "--readmix-child":
+        child_readmix()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--snapcatch-child":
+        child_snapcatch()
     else:
         main()

@@ -44,6 +44,22 @@ class RaftServerConfigKeys:
     STAGING_CATCHUP_GAP_KEY = "raft.server.staging.catchup.gap"
     STAGING_CATCHUP_GAP_DEFAULT = 1000
 
+    # Host-runtime loop sharding (no reference analog; the closest shape is
+    # Netty's NioEventLoopGroup): run this many worker event loops per
+    # RaftServer and hash-pin each Division — its request handling,
+    # appenders, heartbeat sweep share, and outbound transport connections —
+    # to one of them.  1 (the default) = the single-loop runtime, with no
+    # dispatch indirection anywhere.  The traced decomposition that
+    # motivates >1 is in docs/perf.md ("Per-stage residual": ready-callback
+    # queueing on one saturated loop dominates the north-star shape).
+    LOOP_SHARDS_KEY = "raft.tpu.server.loop-shards"
+    LOOP_SHARDS_DEFAULT = 1
+
+    @staticmethod
+    def loop_shards(p: RaftProperties) -> int:
+        return p.get_int(RaftServerConfigKeys.LOOP_SHARDS_KEY,
+                         RaftServerConfigKeys.LOOP_SHARDS_DEFAULT)
+
     @staticmethod
     def storage_dirs(p: RaftProperties) -> list[str]:
         v = p.get(RaftServerConfigKeys.STORAGE_DIR_KEY,

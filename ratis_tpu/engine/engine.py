@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from typing import Callable, Optional, Protocol
 
@@ -168,18 +169,39 @@ class QuorumEngine:
         self.metrics = {"ticks": 0, "acks": 0, "commit_advances": 0,
                         "batched_dispatches": 0, "refresh_rows": 0,
                         "fast_ticks": 0, "refresh_ticks": 0, "idle_skips": 0}
+        # Cross-shard intake safety (raft.tpu.server.loop-shards): divisions
+        # pinned to worker event loops call the intake methods from their
+        # own threads while the tick task reads/swaps the same rings and
+        # mirror on the engine's home loop.  An RLock (re-entrant: an
+        # inline-commit callback may re-enter intake synchronously)
+        # serializes the mutation windows; the home loop lets off-loop
+        # intake wake the tick via call_soon_threadsafe.  With one loop
+        # (the default) every acquisition is uncontended.
+        self._lock = threading.RLock()
+        self._home_loop: Optional[asyncio.AbstractEventLoop] = None
+        # slot -> loop the listener's division runs on (for cross-shard
+        # callback dispatch); absent/same-loop listeners take the direct
+        # await path, identical to the unsharded runtime.
+        self._listener_loops: dict[int, asyncio.AbstractEventLoop] = {}
 
     # -- registration --------------------------------------------------------
 
     def attach(self, listener: EngineListener) -> int:
-        slot = self.state.allocate()
-        self._listeners[slot] = listener
+        with self._lock:
+            slot = self.state.allocate()
+            self._listeners[slot] = listener
+        try:
+            self._listener_loops[slot] = asyncio.get_running_loop()
+        except RuntimeError:
+            pass  # attached outside a loop (tests): direct-await path
         return slot
 
     def detach(self, slot: int) -> None:
         self.end_vote_round(slot)
-        self._listeners.pop(slot, None)
-        self.state.release(slot)
+        with self._lock:
+            self._listeners.pop(slot, None)
+            self._listener_loops.pop(slot, None)
+            self.state.release(slot)
 
     # -- event intake (transport/appender threads call these) ---------------
 
@@ -197,14 +219,15 @@ class QuorumEngine:
         wait on them).  The per-ack math is a [P]-element majority-min
         (P <= 8); the device keeps the work that actually batches — the
         O(G) timeout/staleness/lease sweeps."""
-        s = self.state
-        now = self.clock.now_ms()
-        if s.match_index[slot, peer_slot] < match_index:
-            s.match_index[slot, peer_slot] = match_index
-        if s.last_ack_ms[slot, peer_slot] < now:
-            s.last_ack_ms[slot, peer_slot] = now
-        self._ack_ring.append((slot, peer_slot, match_index, now))
-        self._try_commit_inline(slot, match_index)
+        with self._lock:
+            s = self.state
+            now = self.clock.now_ms()
+            if s.match_index[slot, peer_slot] < match_index:
+                s.match_index[slot, peer_slot] = match_index
+            if s.last_ack_ms[slot, peer_slot] < now:
+                s.last_ack_ms[slot, peer_slot] = now
+            self._ack_ring.append((slot, peer_slot, match_index, now))
+            self._try_commit_inline(slot, match_index)
 
     def _try_commit_inline(self, slot: int, hint: int) -> None:
         """Advance ``slot``'s commit from the host mirror if possible and
@@ -222,7 +245,7 @@ class QuorumEngine:
             # tick path owns this listener's commits: force the next tick
             # through the dispatch (the sweep gate must not skip it)
             self._tick_commit_pending = True
-            self._wake.set()
+            self._wake_set()
             return
         new_commit, did = ref.update_commit(
             s.match_index[slot].tolist(), int(s.self_slot[slot]),
@@ -239,36 +262,104 @@ class QuorumEngine:
         packed slot update for the fast tick path (these fire on every
         append — routing them through mark_dirty would force the dirty-row
         refresh on every tick)."""
-        s = self.state
-        if flush_index < int(s.flush_index[slot]):
-            # regression (follower truncate): rare — take the refresh path,
-            # the device-side scatter-max would ignore a lower value
+        with self._lock:
+            s = self.state
+            if flush_index < int(s.flush_index[slot]):
+                # regression (follower truncate): rare — take the refresh
+                # path, the device-side scatter-max would ignore a lower
+                # value
+                s.flush_index[slot] = flush_index
+                s.mark_dirty(slot)
+                self._wake_set()
+                return
             s.flush_index[slot] = flush_index
-            s.mark_dirty(slot)
-            self._wake.set()
-            return
-        s.flush_index[slot] = flush_index
-        u = self._slot_updates.get(slot)
-        if u is None:
-            self._slot_updates[slot] = [flush_index, _PACK_SENTINEL]
-        elif u[0] == _PACK_SENTINEL or flush_index > u[0]:
-            u[0] = flush_index
-        # A leader's own flush counts toward quorum: try the commit inline
-        # (single-peer groups commit on flush alone).
-        self._try_commit_inline(slot, flush_index)
+            u = self._slot_updates.get(slot)
+            if u is None:
+                self._slot_updates[slot] = [flush_index, _PACK_SENTINEL]
+            elif u[0] == _PACK_SENTINEL or flush_index > u[0]:
+                u[0] = flush_index
+            # A leader's own flush counts toward quorum: try the commit
+            # inline (single-peer groups commit on flush alone).
+            self._try_commit_inline(slot, flush_index)
 
     def on_deadline(self, slot: int, deadline_ms: int) -> None:
         """(Re-)arm a follower election deadline; same packed-update route.
         No wake: a postponed deadline needs no immediate tick."""
-        s = self.state
-        s.election_deadline_ms[slot] = deadline_ms
-        if deadline_ms < self._next_sweep_ms:
-            self._next_sweep_ms = deadline_ms  # earlier than planned sweep
-        u = self._slot_updates.get(slot)
-        if u is None:
-            self._slot_updates[slot] = [_PACK_SENTINEL, deadline_ms]
-        else:
-            u[1] = deadline_ms
+        with self._lock:
+            s = self.state
+            s.election_deadline_ms[slot] = deadline_ms
+            if deadline_ms < self._next_sweep_ms:
+                self._next_sweep_ms = deadline_ms  # earlier than planned
+            u = self._slot_updates.get(slot)
+            if u is None:
+                self._slot_updates[slot] = [_PACK_SENTINEL, deadline_ms]
+            else:
+                u[1] = deadline_ms
+
+    # -- cross-loop plumbing (loop sharding) ---------------------------------
+
+    def _wake_set(self) -> None:
+        """Wake the tick loop from any thread: direct on the home loop,
+        call_soon_threadsafe from a shard loop (asyncio.Event.set is not
+        thread-safe)."""
+        home = self._home_loop
+        if home is not None:
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is not home:
+                try:
+                    home.call_soon_threadsafe(self._wake.set)
+                except RuntimeError:
+                    pass  # home loop closing: nothing left to wake
+                return
+        self._wake.set()
+
+    @staticmethod
+    def _resolve_future(fut: asyncio.Future, result: str) -> None:
+        """set_result on the future's OWN loop (vote futures are created on
+        the division's shard loop; the tick resolves them from the home
+        loop)."""
+        floop = fut.get_loop()
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if floop is running:
+            if not fut.done():
+                fut.set_result(result)
+            return
+
+        def _set() -> None:
+            if not fut.done():
+                fut.set_result(result)
+
+        try:
+            floop.call_soon_threadsafe(_set)
+        except RuntimeError:
+            pass  # owner loop closed: the round's division is gone
+
+    @staticmethod
+    def _cancel_future(fut: asyncio.Future) -> None:
+        floop = fut.get_loop()
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if floop is running:
+            if not fut.done():
+                fut.cancel()
+            return
+
+        def _cancel() -> None:
+            if not fut.done():
+                fut.cancel()
+
+        try:
+            floop.call_soon_threadsafe(_cancel)
+        except RuntimeError:
+            pass
 
     # -- batched vote rounds (SURVEY §3.3 HOT LOOP #2) -----------------------
 
@@ -285,31 +376,35 @@ class QuorumEngine:
         (self-grant pre-set), arm the round deadline, and return a future
         the tick resolves with "PASSED" / "REJECTED" / "TIMEOUT".  The
         conf masks and priorities were already synced via set_conf."""
-        s = self.state
-        s.vote_grants[slot] = False
-        s.vote_rejects[slot] = False
-        s.vote_grants[slot, s.self_slot[slot]] = True
-        s.vote_deadline_ms[slot] = deadline_ms
-        old = self._vote_rounds.pop(slot, None)
-        if old is not None and not old.done():
-            old.cancel()
-        fut = asyncio.get_running_loop().create_future()
-        self._vote_rounds[slot] = fut
-        self._wake.set()
+        with self._lock:
+            s = self.state
+            s.vote_grants[slot] = False
+            s.vote_rejects[slot] = False
+            s.vote_grants[slot, s.self_slot[slot]] = True
+            s.vote_deadline_ms[slot] = deadline_ms
+            old = self._vote_rounds.pop(slot, None)
+            if old is not None:
+                self._cancel_future(old)
+            fut = asyncio.get_running_loop().create_future()
+            self._vote_rounds[slot] = fut
+        self._wake_set()
         return fut
 
     def on_vote_reply(self, slot: int, peer_slot: int, granted: bool) -> None:
-        if slot in self._vote_rounds:
+        with self._lock:
+            if slot not in self._vote_rounds:
+                return
             self._vote_ring.append((slot, peer_slot, granted))
-            self._wake.set()
+        self._wake_set()
 
     def end_vote_round(self, slot: int) -> None:
         """Abandon a round (candidate stopped / stepped down / special
         reply handled inline): cancel its future and disarm the deadline."""
-        self.state.vote_deadline_ms[slot] = NO_DEADLINE
-        fut = self._vote_rounds.pop(slot, None)
-        if fut is not None and not fut.done():
-            fut.cancel()
+        with self._lock:
+            self.state.vote_deadline_ms[slot] = NO_DEADLINE
+            fut = self._vote_rounds.pop(slot, None)
+        if fut is not None:
+            self._cancel_future(fut)
 
     def expire_vote_round(self, slot: int) -> None:
         """Every peer has replied or failed: pull the round deadline to now
@@ -317,12 +412,14 @@ class QuorumEngine:
         outstanding==0 early exit of the reference's waitForResults (a
         majority gated only on a SILENT higher-priority peer must not wait
         out the full randomized deadline once that peer's RPC has failed)."""
-        if slot in self._vote_rounds:
+        with self._lock:
+            if slot not in self._vote_rounds:
+                return
             s = self.state
             now = np.int32(self.clock.now_ms())
             if s.vote_deadline_ms[slot] > now:
                 s.vote_deadline_ms[slot] = now
-            self._wake.set()
+        self._wake_set()
 
     def _vote_pass(self, now: int) -> list[tuple[asyncio.Future, str]]:
         """Apply queued vote replies and tally EVERY open round in one
@@ -371,20 +468,23 @@ class QuorumEngine:
         lower the mirror AND clamp any acks for this (group, peer) still
         queued in the ring — otherwise the next tick's scatter-max replays a
         pre-restart ack and silently restores the lost match."""
-        self._ack_ring = [
-            (g, p, min(m, match_index) if (g, p) == (slot, peer_slot) else m, t)
-            for g, p, m, t in self._ack_ring]
-        self.state.match_index[slot, peer_slot] = match_index
-        self.state.mark_dirty(slot)
+        with self._lock:
+            self._ack_ring = [
+                (g, p,
+                 min(m, match_index) if (g, p) == (slot, peer_slot) else m, t)
+                for g, p, m, t in self._ack_ring]
+            self.state.match_index[slot, peer_slot] = match_index
+            self.state.mark_dirty(slot)
 
     def notify(self) -> None:
         """Wake the tick loop early (e.g. flush index advanced)."""
-        self._wake.set()
+        self._wake_set()
 
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
         self._running = True
+        self._home_loop = asyncio.get_running_loop()
         if self.profile_dir and QuorumEngine._profiling_owner is None:
             import jax
             try:
@@ -492,6 +592,45 @@ class QuorumEngine:
     _EVENT_BACKLOG_MAX = 8192
 
     async def tick(self) -> None:
+        # The math pass runs under the intake lock: shard-loop intake
+        # (on_ack/on_flush/...) and the tick swap/read the same rings and
+        # host mirror.  The lock is released BEFORE listener callbacks —
+        # holding a threading lock across awaits would stall every shard's
+        # intake for the duration of division code.
+        with self._lock:
+            changed, votes = self._tick_locked()
+        for fut, result in votes:
+            self._resolve_future(fut, result)
+
+        # dispatch callbacks outside the math pass; a listener pinned to a
+        # different shard loop gets its callback ON that loop
+        running = asyncio.get_running_loop()
+        for slot, kind, value in changed:
+            listener = self._listeners.get(slot)
+            if listener is None:
+                continue
+            if kind == "commit":
+                self.metrics["commit_advances"] += 1
+                coro = listener.on_commit_advance(value)
+            elif kind == "timeout":
+                coro = listener.on_election_timeout()
+            else:  # "stale"
+                if getattr(listener, "hibernating", False):
+                    continue  # requested silence; cheap skip, no coroutine
+                coro = listener.on_leadership_stale()
+            lloop = self._listener_loops.get(slot)
+            if lloop is None or lloop is running:
+                await coro
+            else:
+                try:
+                    await asyncio.wrap_future(
+                        asyncio.run_coroutine_threadsafe(coro, lloop))
+                except RuntimeError:
+                    coro.close()  # shard loop gone (server closing)
+
+    def _tick_locked(self) -> tuple[list, list]:
+        """One tick's math pass (caller holds the intake lock).  Returns
+        (changed listener events, resolved vote futures)."""
         s = self.state
         now = self._maybe_rebase_epoch(self.clock.now_ms())
         self.metrics["ticks"] += 1
@@ -502,7 +641,7 @@ class QuorumEngine:
             s.dirty.clear()
             self._slot_updates.clear()
             self._dev = None
-            return
+            return [], []
 
         use_batched = (self.use_device
                        or len(active) >= self.scalar_fallback_threshold)
@@ -518,7 +657,7 @@ class QuorumEngine:
             # bigger packed batch (the shape the kernel wants) and the
             # engine's dispatch rate drops from per-tick to per-sweep.
             self.metrics["idle_skips"] += 1
-            return
+            return [], []
         if use_batched:
             # why did the gate let this dispatch through? (the dispatch
             # count at scale is THE batched-mode cost driver; this makes
@@ -568,24 +707,7 @@ class QuorumEngine:
 
         votes = (self._vote_pass(now)
                  if (self._vote_rounds or self._vote_ring) else [])
-        for fut, result in votes:
-            if not fut.done():
-                fut.set_result(result)
-
-        # dispatch callbacks outside the math pass
-        for slot, kind, value in changed:
-            listener = self._listeners.get(slot)
-            if listener is None:
-                continue
-            if kind == "commit":
-                self.metrics["commit_advances"] += 1
-                await listener.on_commit_advance(value)
-            elif kind == "timeout":
-                await listener.on_election_timeout()
-            elif kind == "stale":
-                if getattr(listener, "hibernating", False):
-                    continue  # requested silence; cheap skip, no coroutine
-                await listener.on_leadership_stale()
+        return changed, votes
 
     def _compute_next_sweep(self, now: int) -> int:
         """Earliest time the device must be consulted again with no new

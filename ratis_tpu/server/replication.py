@@ -71,6 +71,10 @@ class PeerSender:
             "envelopes": 0, "items": 0, "rewinds": 0}
         self._dirty: dict[object, None] = {}  # insertion-ordered appender set
         self.refs: set = set()  # registered appenders (scheduler-managed)
+        # the loop this sender (and every appender feeding it) lives on:
+        # with loop sharding there is one sender per (destination, shard),
+        # and the scheduler's close() must unwind it on this loop
+        self.loop = asyncio.get_running_loop()
         self._wake = asyncio.Event()
         self._slots = asyncio.Semaphore(max(1, inflight_cap))
         self._running = True
@@ -238,7 +242,12 @@ class ReplicationScheduler:
         self.coalescing = coalescing
         self.inflight_cap = inflight_cap
         self.envelope_byte_limit = envelope_byte_limit
-        self._senders: dict[RaftPeerId, PeerSender] = {}
+        # keyed by (destination, calling loop): with loop sharding each
+        # shard gets its own sender per destination — its flush task and
+        # outbound connection live on the shard's loop, so one shard's
+        # flush never queues behind another's (unsharded: one loop, one
+        # sender per destination, exactly the old shape)
+        self._senders: dict[tuple, PeerSender] = {}
         self._closed = False
         # shared across senders: folding evidence for tests/benchmarks;
         # "rewinds" counts INCONSISTENCY-triggered window resets (the
@@ -255,8 +264,16 @@ class ReplicationScheduler:
         from ratis_tpu.protocol.raftrpc import FANOUT_STATS
         return dict(FANOUT_STATS)
 
+    @staticmethod
+    def _loop_key() -> int:
+        try:
+            return id(asyncio.get_running_loop())
+        except RuntimeError:
+            return 0
+
     def sender_for(self, to: RaftPeerId) -> PeerSender:
-        s = self._senders.get(to)
+        key = (to, self._loop_key())
+        s = self._senders.get(key)
         if s is None:
             if self._closed:
                 raise RuntimeError("replication scheduler closed")
@@ -264,7 +281,7 @@ class ReplicationScheduler:
                            inflight_cap=self.inflight_cap,
                            envelope_byte_limit=self.envelope_byte_limit,
                            metrics=self.metrics)
-            self._senders[to] = s
+            self._senders[key] = s
         return s
 
     def acquire(self, to: RaftPeerId, appender) -> PeerSender:
@@ -276,18 +293,43 @@ class ReplicationScheduler:
         return s
 
     async def release(self, to: RaftPeerId, appender) -> None:
-        s = self._senders.get(to)
+        # appenders acquire and release on their own (shard) loop, so the
+        # loop key resolves to the same sender acquire() returned
+        key = (to, self._loop_key())
+        s = self._senders.get(key)
         if s is None:
             return
         s.refs.discard(appender)
         s.unmark(appender)
         if not s.refs:
-            self._senders.pop(to, None)
+            self._senders.pop(key, None)
             await s.close()
 
     async def close(self) -> None:
         self._closed = True
         senders = list(self._senders.values())
         self._senders.clear()
+        try:
+            current = asyncio.get_running_loop()
+        except RuntimeError:
+            current = None
         for s in senders:
-            await s.close()
+            if s.loop is current:
+                await s.close()
+            elif s.loop.is_running():
+                # shard-owned sender: unwind it on its own loop (its tasks
+                # and wake event are loop-affine)
+                try:
+                    await asyncio.wrap_future(
+                        asyncio.run_coroutine_threadsafe(s.close(), s.loop))
+                except Exception:
+                    LOG.exception("cross-loop sender close failed for %s",
+                                  s.to)
+            else:
+                # owner loop already gone (test teardown): its tasks can
+                # never resume — best-effort cancel, nothing to await
+                s._running = False
+                for t in (s._task, *s._inflight_tasks):
+                    if t is not None:
+                        t.cancel()
+                s._inflight_tasks.clear()

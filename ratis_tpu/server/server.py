@@ -51,17 +51,26 @@ class HeartbeatScheduler:
     without it, each appender sends its own unary AppendEntries heartbeat
     (the reference's cost shape)."""
 
-    def __init__(self, server: "RaftServer", interval_s: float):
+    def __init__(self, server: "RaftServer", interval_s: float,
+                 shard: Optional[int] = None, service=None):
         self.server = server
         self.interval_s = interval_s
+        # loop sharding: shard i's scheduler runs ON shard i's loop and
+        # sweeps ONLY divisions pinned there (appender/leader state is
+        # loop-affine).  None = the single-loop sweep over every division.
+        self.shard = shard
+        self.service = service  # BulkHeartbeatService (defaults to server's)
         self._task: Optional[asyncio.Task] = None
         self._running = False
         self._sweep_seq = 0
 
     def start(self) -> None:
         self._running = True
-        self._task = asyncio.create_task(
-            self._run(), name=f"heartbeats-{self.server.peer_id}")
+        if self.service is None:
+            self.service = self.server.heartbeats
+        name = (f"heartbeats-{self.server.peer_id}" if self.shard is None
+                else f"heartbeats-{self.server.peer_id}-s{self.shard}")
+        self._task = asyncio.create_task(self._run(), name=name)
         self._task.add_done_callback(self._on_exit)
 
     def _on_exit(self, task: asyncio.Task) -> None:
@@ -95,6 +104,10 @@ class HeartbeatScheduler:
             bulk: dict[RaftPeerId, tuple[list, list]] = {}
             sweep = 0
             for i, div in enumerate(list(self.server.divisions.values())):
+                if self.shard is not None \
+                        and self.server.shard_of_group(div.group_id) \
+                        != self.shard:
+                    continue  # another shard's scheduler owns this division
                 # One division's failure must never kill the single
                 # server-wide heartbeat task — that silently collapses every
                 # leadership on the server with no recovery path.
@@ -138,7 +151,7 @@ class HeartbeatScheduler:
                     LOG.exception("heartbeat sweep failed for %s",
                                   div.member_id)
             for to, (items, appenders) in bulk.items():
-                self.server.heartbeats.submit(to, items, appenders)
+                self.service.submit(to, items, appenders)
 
 
 class BulkHeartbeatService:
@@ -228,6 +241,14 @@ class RaftServer:
         self._transport_factory = transport_factory
         self.life_cycle = LifeCycle(f"server-{peer_id}")
         self.divisions: dict[RaftGroupId, Division] = {}
+        # Loop sharding (raft.tpu.server.loop-shards): N worker event loops
+        # with every Division hash-pinned to one; None (shards=1, the
+        # default) keeps the single-loop runtime with zero indirection.
+        self.loop_shards = RaftServerConfigKeys.loop_shards(properties)
+        self.shards = None
+        if self.loop_shards > 1:
+            from ratis_tpu.server.shards import LoopShardPool
+            self.shards = LoopShardPool(f"{peer_id}", self.loop_shards)
         # Transaction contexts between append and apply
         # (reference TransactionManager, ratis-server/.../impl/).
         self.transactions: dict = {}
@@ -274,6 +295,10 @@ class RaftServer:
             RaftServerConfigKeys.Rpc.timeout_min(p).seconds / 2
         self.heartbeat_scheduler = HeartbeatScheduler(
             self, self.heartbeat_interval_s)
+        # sharded mode: one (scheduler, bulk service) pair per shard, each
+        # living on its shard's loop (built in start(); the unsharded
+        # fields above stay exactly the single-loop runtime)
+        self._hb_shards: list[HeartbeatScheduler] = []
         # peer id -> network address, fed from every conf the server sees
         # (division conf syncs, staging, group adds); the resolver transports
         # dial by (reference PeerProxyMap's address source).
@@ -314,6 +339,10 @@ class RaftServer:
 
     async def start(self) -> None:
         self.life_cycle.transition(LifeCycleState.STARTING)
+        if self.shards is not None:
+            # before anything that places a division: boot-scan recovery and
+            # the initial group below pin divisions to shard loops
+            self.shards.start()
         await self.engine.start()
         from ratis_tpu.conf.keys import RaftServerConfigKeys as _K
         if _K.Gc.discipline(self.properties):
@@ -332,7 +361,18 @@ class RaftServer:
             from ratis_tpu.server.pause_monitor import PauseMonitor
             self.pause_monitor = PauseMonitor(self)
             self.pause_monitor.start()
-        self.heartbeat_scheduler.start()
+        if self.shards is None:
+            self.heartbeat_scheduler.start()
+        else:
+            # one sweep per shard, each ON its shard's loop over only its
+            # own divisions (appender state is loop-affine), each with its
+            # own bulk service so reply dispatch stays on-shard
+            for i in range(self.shards.n):
+                svc = BulkHeartbeatService(self)
+                sched = HeartbeatScheduler(self, self.heartbeat_interval_s,
+                                           shard=i, service=svc)
+                self._hb_shards.append(sched)
+                self.shards.call_soon(i, sched.start)
         # Boot scan: recover every group found on disk
         # (reference RaftServerProxy.initGroups:257-288).
         root = self._storage_root()
@@ -381,7 +421,11 @@ class RaftServer:
             from ratis_tpu.util import gcdiscipline
             gcdiscipline.disable()
             self._gc_disciplined = False
-        await self.heartbeat_scheduler.close()
+        if self.shards is None:
+            await self.heartbeat_scheduler.close()
+        else:
+            for sched in self._hb_shards:
+                await self.shards.run_on(sched.shard, sched.close())
         await self.transport.close()
         if self.datastream is not None:
             await self.datastream.close()
@@ -394,13 +438,19 @@ class RaftServer:
             except Exception:
                 LOG.exception("%s notify_server_shutdown raised",
                               div.member_id)
-            await div.close()
+            await self._run_on_division_loop(div.group_id, div.close())
         self.divisions.clear()
         # after divisions: a live leader appender could otherwise submit a
         # heartbeat that recreates a flusher task in a closed coalescer
         await self.heartbeats.close()
+        for sched in self._hb_shards:
+            if sched.service is not None:
+                await self.shards.run_on(sched.shard, sched.service.close())
+        self._hb_shards.clear()
         await self.replication.close()
         await self.engine.close()
+        if self.shards is not None:
+            await self.shards.close()
         self.life_cycle.transition(LifeCycleState.CLOSED)
 
     async def _gc_janitor(self, freeze_idle_s: float,
@@ -501,11 +551,13 @@ class RaftServer:
             from ratis_tpu.util import gcdiscipline
             gcdiscipline.note_mutation()
         try:
-            await div.start()
+            # sharded: the division LIVES on its pinned loop from the first
+            # task it spawns (apply loop, election machinery, windows)
+            await self._run_on_division_loop(group.group_id, div.start())
         except Exception:
             self.divisions.pop(group.group_id, None)
             try:
-                await div.close()
+                await self._run_on_division_loop(group.group_id, div.close())
             except Exception:
                 LOG.exception("%s: cleanup after failed start of %s",
                               self.peer_id, group.group_id)
@@ -525,11 +577,18 @@ class RaftServer:
             gcdiscipline.note_mutation()
         await div.state_machine.notify_group_remove()
         storage = div.storage
-        await div.close()
+        await self._run_on_division_loop(group_id, div.close())
         if delete_directory and storage is not None:
             import shutil
             await asyncio.to_thread(
                 shutil.rmtree, storage.root, ignore_errors=True)
+
+    async def bootstrap_division(self, group_id: RaftGroupId) -> None:
+        """Appointed-leader bootstrap on the division's own loop (harness/
+        operator entry point; Division.bootstrap_as_leader is loop-affine
+        like every other division method)."""
+        div = self.get_division(group_id)
+        await self._run_on_division_loop(group_id, div.bootstrap_as_leader())
 
     def get_division(self, group_id: RaftGroupId) -> Division:
         div = self.divisions.get(group_id)
@@ -544,12 +603,35 @@ class RaftServer:
 
     # ------------------------------------------------------------- routing
 
+    def shard_of_group(self, group_id: RaftGroupId) -> int:
+        """Loop-shard index owning ``group_id``'s division (0 unsharded)."""
+        if self.shards is None:
+            return 0
+        return self.shards.shard_of(group_id.to_bytes())
+
+    async def _run_on_division_loop(self, group_id: RaftGroupId, coro):
+        """Await ``coro`` on the loop owning ``group_id``'s division; a
+        plain await when unsharded or already on the owning loop."""
+        if self.shards is None:
+            return await coro
+        return await self.shards.run_on(self.shard_of_group(group_id), coro)
+
     async def _handle_server_rpc(self, msg):
         from ratis_tpu.protocol.raftrpc import BulkHeartbeat
         if isinstance(msg, AppendEnvelope):
             return await self._handle_append_envelope(msg)
         if isinstance(msg, BulkHeartbeat):
             return await self._handle_bulk_heartbeat(msg)
+        if self.shards is not None:
+            # division state is loop-affine: handle on the owning shard
+            # (exceptions — e.g. GroupMismatch — propagate back through the
+            # wrapped future unchanged)
+            return await self.shards.run_on(
+                self.shard_of_group(msg.header.group_id),
+                self._handle_division_rpc(msg))
+        return await self._handle_division_rpc(msg)
+
+    async def _handle_division_rpc(self, msg):
         div = self.get_division(msg.header.group_id)
         if isinstance(msg, AppendEntriesRequest):
             return await div.handle_append_entries(msg)
@@ -586,7 +668,23 @@ class RaftServer:
                 except Exception:
                     results[i] = None
 
-        await asyncio.gather(*(run_group(ix) for ix in by_group.values()))
+        if self.shards is None:
+            await asyncio.gather(*(run_group(ix) for ix in by_group.values()))
+            return AppendEnvelopeReply(tuple(results))
+
+        # sharded: each group's ordered run executes on its owning loop;
+        # groups on one shard still run concurrently there (gather inside
+        # the shard hop), shards run in parallel.  The flat results list is
+        # index-disjoint across groups, so cross-thread writes are safe.
+        by_shard: dict[int, list] = {}
+        for gid, idxs in by_group.items():
+            by_shard.setdefault(self.shard_of_group(gid), []).append(idxs)
+
+        async def run_shard(group_runs):
+            await asyncio.gather(*(run_group(ix) for ix in group_runs))
+
+        await asyncio.gather(*(self.shards.run_on(k, run_shard(v))
+                               for k, v in by_shard.items()))
         return AppendEnvelopeReply(tuple(results))
 
     async def _handle_bulk_heartbeat(self, msg):
@@ -611,27 +709,47 @@ class RaftServer:
         miss = (BULK_HB_UNKNOWN_GROUP, -1, -1, -1, -1)
         busy = (BULK_HB_BUSY, -1, -1, -1, -1)
         results: list = [miss] * len(items)
-        for n, item in enumerate(items):
-            gid_bytes, term, commit, commit_term = item[:4]
-            hibernate = len(item) > 4 and bool(item[4])
-            div = self.divisions.get(RaftGroupId.value_of(gid_bytes))
-            if div is None:
-                pass  # results[n] stays UNKNOWN_GROUP
-            elif div.append_lock_locked():
-                results[n] = busy
-            else:
-                try:
-                    results[n] = await div.on_bulk_heartbeat(
-                        src, term, commit, commit_term,
-                        hibernate=hibernate)
-                except Exception:
-                    LOG.exception("%s bulk heartbeat item failed",
-                                  self.peer_id)
-            if (n + 1) % 1024 == 0:
-                # coarse yield cadence, same rationale as the sweep's: on a
-                # loaded loop each yield waits out the ready backlog, and
-                # heartbeat DELIVERY latency is an election-liveness input
-                await asyncio.sleep(0)
+
+        async def run_items(idxs) -> None:
+            done = 0
+            for n in idxs:
+                item = items[n]
+                gid_bytes, term, commit, commit_term = item[:4]
+                hibernate = len(item) > 4 and bool(item[4])
+                div = self.divisions.get(RaftGroupId.value_of(gid_bytes))
+                if div is None:
+                    pass  # results[n] stays UNKNOWN_GROUP
+                elif div.append_lock_locked():
+                    results[n] = busy
+                else:
+                    try:
+                        results[n] = await div.on_bulk_heartbeat(
+                            src, term, commit, commit_term,
+                            hibernate=hibernate)
+                    except Exception:
+                        LOG.exception("%s bulk heartbeat item failed",
+                                      self.peer_id)
+                done += 1
+                if done % 1024 == 0:
+                    # coarse yield cadence, same rationale as the sweep's:
+                    # on a loaded loop each yield waits out the ready
+                    # backlog, and heartbeat DELIVERY latency is an
+                    # election-liveness input
+                    await asyncio.sleep(0)
+
+        if self.shards is None:
+            await run_items(range(len(items)))
+        else:
+            # item handling is loop-affine (division append locks/deadline
+            # state): split the bulk by owning shard, handle shard slices
+            # in parallel, keep per-item reply alignment via the shared
+            # index-disjoint results list
+            by_shard: dict[int, list[int]] = {}
+            for n, item in enumerate(items):
+                gid = RaftGroupId.value_of(item[0])
+                by_shard.setdefault(self.shard_of_group(gid), []).append(n)
+            await asyncio.gather(*(self.shards.run_on(k, run_items(v))
+                                   for k, v in by_shard.items()))
         return BulkHeartbeatReply(tuple(results))
 
     async def _handle_client_request(self, request: RaftClientRequest
@@ -660,7 +778,10 @@ class RaftServer:
             TRACER.record(request.trace_id, STAGE_ROUTE, trace_t0,
                           TRACER.now())
         try:
-            reply = await div.submit_client_request(request)
+            # sharded: the division's whole submit path (windows, append,
+            # quorum wait, apply wait) runs on its pinned loop
+            reply = await self._run_on_division_loop(
+                request.group_id, div.submit_client_request(request))
         except RaftException as e:
             return RaftClientReply.failure_reply(request, e)
         except Exception as e:  # never leak raw errors to the wire
@@ -680,7 +801,8 @@ class RaftServer:
         may not be the leader — forward like any client request would be."""
         try:
             div = self.get_division(request.group_id)
-            reply = await div.submit_client_request(request)
+            reply = await self._run_on_division_loop(
+                request.group_id, div.submit_client_request(request))
         except RaftException as e:
             return RaftClientReply.failure_reply(request, e)
         nle = reply.get_not_leader_exception()

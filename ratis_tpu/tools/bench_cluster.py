@@ -51,9 +51,14 @@ def bench_properties(batched: bool, num_groups: int = 1,
                      num_servers: int = 3,
                      transport: str = "sim",
                      trace: bool = False,
-                     trace_sample: int = 16) -> RaftProperties:
+                     trace_sample: int = 16,
+                     loop_shards: int = 1) -> RaftProperties:
     from ratis_tpu.engine.engine import QuorumEngine
     p = RaftProperties()
+    if loop_shards > 1:
+        # host-runtime loop sharding: N worker event loops per server with
+        # divisions (and their transport connections) hash-pinned to one
+        p.set(RaftServerConfigKeys.LOOP_SHARDS_KEY, str(loop_shards))
     # Timeouts scale with CHANNEL density (groups x followers): background
     # heartbeat volume is O(channels / interval) — one appender item per
     # follower per group, like the reference — so a fixed 1s/2s that is
@@ -171,7 +176,9 @@ class BenchCluster:
                  batched: bool = True, transport: str = "sim",
                  sm: str = "counter", datastream: bool = False,
                  hibernate: bool = False, mesh_devices: int = 0,
-                 trace: bool = False, trace_sample: int = 16):
+                 trace: bool = False, trace_sample: int = 16,
+                 loop_shards: int = 1, extra_props: Optional[dict] = None,
+                 sm_storage_root: Optional[str] = None):
         self.num_groups = num_groups
         self.batched = batched
         self.transport = transport
@@ -180,6 +187,7 @@ class BenchCluster:
         self.hibernate = hibernate
         self.mesh_devices = mesh_devices
         self.trace = trace
+        self.loop_shards = loop_shards
         if transport in ("tcp", "grpc"):
             # Real localhost sockets: every RPC pays framing + syscalls, so
             # the per-(group,follower) stream shape costs what it costs the
@@ -216,7 +224,10 @@ class BenchCluster:
                                            num_servers=num_servers,
                                            transport=transport,
                                            trace=trace,
-                                           trace_sample=trace_sample)
+                                           trace_sample=trace_sample,
+                                           loop_shards=loop_shards)
+        for k, v in (extra_props or {}).items():
+            self.properties.set(k, str(v))
         if self.network is not None:
             # the sim's default 3s rpc deadline models a small cluster; a
             # legitimately-busy handler at thousands of co-hosted groups
@@ -240,9 +251,24 @@ class BenchCluster:
         else:
             def _sm_factory():
                 return CounterStateMachine()
+        def _registry_for(peer_id):
+            if sm_storage_root is None:
+                return lambda gid: _sm_factory()
+
+            def _reg(gid):
+                # real snapshot storage even with the in-memory log: the
+                # snapshot rungs (take/purge/chunked-install) need a place
+                # for SM snapshot files, exactly like the reference's
+                # SimpleStateMachineStorage under the raft storage dir
+                m = _sm_factory()
+                m.get_state_machine_storage().init(
+                    f"{sm_storage_root}/{peer_id}/{gid}")
+                return m
+            return _reg
+
         self.servers: list[RaftServer] = [
             RaftServer(p.id, p.address,
-                       state_machine_registry=lambda gid: _sm_factory(),
+                       state_machine_registry=_registry_for(p.id),
                        properties=self.properties,
                        transport_factory=self.factory,
                        group=self.groups[0])
@@ -315,7 +341,9 @@ class BenchCluster:
         for g in groups:
             d = self.servers[0].divisions.get(g.group_id)
             if d is not None and d.is_follower():
-                boots.append(d.bootstrap_as_leader())
+                # via the server so a loop-sharded division bootstraps on
+                # its own pinned loop
+                boots.append(self.servers[0].bootstrap_division(g.group_id))
         if boots:
             results = await asyncio.gather(*boots, return_exceptions=True)
             for r in results:
@@ -401,13 +429,27 @@ class BenchCluster:
     async def run_load(self, writes_per_group: int,
                        concurrency: int = 256,
                        message_factory=None,
-                       active_groups: Optional[int] = None) -> dict:
+                       active_groups: Optional[int] = None,
+                       client_shards: int = 1) -> dict:
         """Drive writes_per_group sequential writes per group, groups
         concurrent under a global in-flight bound; returns throughput and
         latency percentiles.  ``message_factory`` builds per-write payloads
         (default: the counter INCREMENT).  ``active_groups`` restricts the
         load to the first N groups — the sparse multi-tenant shape where
-        most hosted groups are cold."""
+        most hosted groups are cold.  ``client_shards`` > 1 splits the
+        driver across that many threads, each with its own event loop and
+        its own client connections (real-socket transports only): the
+        client-side half of the measured event-loop queueing residual
+        (docs/perf.md) scales with in-flight writes per loop, and this is
+        the knob that divides it."""
+        if client_shards > 1:
+            if self.transport not in ("tcp", "grpc"):
+                raise ValueError(
+                    "client_shards needs a real-socket transport (the sim "
+                    "hub is single-loop by construction)")
+            return await self._run_load_sharded(
+                writes_per_group, concurrency, message_factory,
+                active_groups, client_shards)
         # properties matter here: the client plane gets the same wire
         # coalescing conf as the servers (raft.tpu.tcp/grpc flush keys)
         client = self.factory.new_client_transport(self.properties)
@@ -467,7 +509,569 @@ class BenchCluster:
             "prewarm_s": round(self.prewarm_s, 2),
         }
 
+    async def _run_load_sharded(self, writes_per_group: int,
+                                concurrency: int, message_factory,
+                                active_groups: Optional[int],
+                                client_shards: int) -> dict:
+        """Client-sharded load: each shard is a thread with its own event
+        loop, its own client transport (own sockets), and a round-robin
+        slice of the groups; the in-flight budget is split evenly.  The
+        leader-hint map and tracer are shared (both thread-safe)."""
+        target_groups = (self.groups if active_groups is None
+                         else self.groups[:active_groups])
+        parts = [target_groups[i::client_shards]
+                 for i in range(client_shards)]
+        parts = [pt for pt in parts if pt]
+        per_shard_conc = max(1, concurrency // len(parts))
 
+        def drive(part):
+            async def run():
+                client = self.factory.new_client_transport(self.properties)
+                sem = asyncio.Semaphore(per_shard_conc)
+                lat: list[float] = []
+                failures: list[str] = []
+
+                async def group_load(g: RaftGroup):
+                    client_id = ClientId.random_id()
+                    for _ in range(writes_per_group):
+                        async with sem:
+                            msg = (message_factory()
+                                   if message_factory is not None
+                                   else b"INCREMENT")
+                            t0 = time.monotonic()
+                            try:
+                                await self._write(client, client_id,
+                                                  g.group_id, message=msg)
+                            except TimeoutError as e:
+                                failures.append(str(g.group_id))
+                                print(f"bench: WRITE FAILED {g.group_id}: "
+                                      f"{e}", file=sys.stderr, flush=True)
+                                continue
+                            lat.append(time.monotonic() - t0)
+
+                await asyncio.gather(*(group_load(g) for g in part))
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+                return lat, failures
+
+            return asyncio.run(run())
+
+        t_start = time.monotonic()
+        outs = await asyncio.gather(
+            *(asyncio.to_thread(drive, pt) for pt in parts))
+        elapsed = time.monotonic() - t_start
+        latencies = sorted(x for lat, _ in outs for x in lat)
+        failures = [x for _, f in outs for x in f]
+        total = len(target_groups) * writes_per_group
+        if not latencies or len(failures) > max(8, total // 100):
+            raise TimeoutError(
+                f"{len(failures)}/{total} writes failed — not a tail "
+                f"event, the rung is broken: {failures[:5]}")
+        n = len(latencies)
+        return {
+            "commits": total - len(failures),
+            "write_failures": len(failures),
+            "elapsed_s": round(elapsed, 3),
+            "commits_per_sec": round((total - len(failures)) / elapsed, 1),
+            "p50_ms": round(latencies[n // 2] * 1e3, 2),
+            "p99_ms": round(latencies[min(n - 1, (n * 99) // 100)] * 1e3, 2),
+            "election_convergence_s": round(self.election_convergence_s, 2),
+            "prewarm_s": round(self.prewarm_s, 2),
+            "client_shards": len(parts),
+        }
+
+
+
+
+# ------------------------------------------------- multi-process cluster
+#
+# The in-process BenchCluster time-slices 5 servers + the client drivers
+# through ONE GIL — which is exactly the single-event-loop queueing the
+# traced decomposition blames for the north-star residual (docs/perf.md).
+# This harness spawns each peer as its own subprocess (own engine, own GC
+# discipline, real-socket transports only) and shards the load generator
+# across client subprocesses, so the bench measures the DEPLOYMENT shape
+# instead of a one-GIL approximation of it.
+#
+# Protocol (newline-delimited over the child's stdin/stdout):
+#   parent -> server child:  one JSON spec line, then APPOINT / SEAL /
+#                            RESET_TRACE / REPORT / EXIT commands
+#   server child -> parent:  MPADDED, MPREADY <s>, MPSEALED, MPTRACED,
+#                            MPREPORT <json>
+#   parent -> client child:  one JSON spec line
+#   client child -> parent:  MPRESULT <json>
+
+def _repo_root() -> str:
+    import os
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _mp_force_cpu() -> None:
+    """Pin the CPU jax platform in a measurement child (the ambient axon
+    remote-TPU plugin dials a tunnel at backend init)."""
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def _mp_sm_factory(sm: str):
+    if sm == "filestore":
+        from ratis_tpu.models.filestore import FileStoreStateMachine
+        return lambda: FileStoreStateMachine()
+    if sm == "arithmetic":
+        from ratis_tpu.models.arithmetic import ArithmeticStateMachine
+        return lambda: ArithmeticStateMachine()
+    return lambda: CounterStateMachine()
+
+
+def _mp_build_groups(spec: dict):
+    peers = [RaftPeer(RaftPeerId.value_of(pid), address=addr)
+             for pid, addr in spec["peers"]]
+    groups = [RaftGroup.value_of(
+        RaftGroupId.value_of(bytes.fromhex(h)), peers)
+        for h in spec["groups"]]
+    return peers, groups
+
+
+def _mp_server_main() -> None:
+    """One cluster peer as its own process (``--mp-server``)."""
+    import gc
+    import json
+    import os
+
+    _mp_force_cpu()
+    spec = json.loads(sys.stdin.readline())
+    gc.disable()  # bring-up heap discipline, same as _started_cluster
+
+    async def main() -> None:
+        import ratis_tpu.transport.tcp  # noqa: F401 (registers TCP)
+        from ratis_tpu.transport.base import TransportFactory
+        peers, groups = _mp_build_groups(spec)
+        num_groups = len(groups)
+        batched = spec.get("batched", True)
+        transport = spec.get("transport", "tcp")
+        if transport == "grpc":
+            import ratis_tpu.transport.grpc  # noqa: F401
+        factory = TransportFactory.get(
+            "GRPC" if transport == "grpc" else "TCP")
+        properties = bench_properties(
+            batched, num_groups, num_servers=len(peers),
+            transport=transport, trace=spec.get("trace", False),
+            trace_sample=spec.get("trace_sample", 32),
+            loop_shards=spec.get("loop_shards", 1))
+        me = peers[spec["peer_index"]]
+        sm_factory = _mp_sm_factory(spec.get("sm", "counter"))
+        if batched:
+            from ratis_tpu.engine.engine import QuorumEngine
+            top = max(QuorumEngine._bucket(num_groups), 64)
+            buckets, b = [], 64
+            while b <= max(top, 4096):
+                buckets.append(b)
+                b *= 4
+        server = RaftServer(me.id, me.address,
+                            state_machine_registry=lambda gid: sm_factory(),
+                            properties=properties,
+                            transport_factory=factory,
+                            group=groups[0])
+        if batched:
+            server.engine.prewarm(
+                group_counts=[x for x in buckets if x <= top],
+                event_counts=buckets)
+        await server.start()
+        # Phase handshake: report STARTED (imports + prewarm + transport
+        # up) and only add groups when the parent says every peer is
+        # there.  Without the barrier, the slowest child's jax import
+        # lands inside its siblings' election timeouts and fresh
+        # followers self-elect against the not-yet-sent appointments.
+        print("MPSTARTED", flush=True)
+
+        loop = asyncio.get_running_loop()
+        while True:
+            line = await loop.run_in_executor(None, sys.stdin.readline)
+            cmd = line.strip()
+            if not line or cmd == "EXIT":
+                # measurement child: no graceful unwind of thousands of
+                # divisions — the OS reclaims the process (bench.py's
+                # children make the same trade)
+                os._exit(0)
+            elif cmd == "ADDGROUPS":
+                wave = 512
+                for i in range(1, len(groups), wave):
+                    await asyncio.gather(*(server.group_add(g)
+                                           for g in groups[i:i + wave]))
+                print("MPADDED", flush=True)
+            elif cmd == "APPOINT":
+                t0 = time.monotonic()
+                bw = 256
+                for i in range(0, len(groups), bw):
+                    batch = groups[i:i + bw]
+                    res = await asyncio.gather(
+                        *(server.bootstrap_division(g.group_id)
+                          for g in batch), return_exceptions=True)
+                    for r in res:
+                        if isinstance(r, BaseException):
+                            print(f"mp-server: bootstrap fell back: {r}",
+                                  file=sys.stderr, flush=True)
+                    deadline = time.monotonic() + 300.0
+                    pending = {g.group_id for g in batch}
+                    while pending and time.monotonic() < deadline:
+                        done = set()
+                        for gid in pending:
+                            d = server.divisions.get(gid)
+                            if d is None:
+                                continue
+                            if d.is_leader() and d.leader_ctx is not None \
+                                    and d.leader_ctx.leader_ready.done():
+                                done.add(gid)
+                            elif not d.is_leader() \
+                                    and d.state.leader_id is not None:
+                                # a follower election won the group before
+                                # our appointment's first heartbeat landed
+                                # (slow multi-process bring-up): a leader
+                                # EXISTS, clients fail over to it — ready
+                                done.add(gid)
+                        pending -= done
+                        if pending:
+                            await asyncio.sleep(0.05)
+                    if pending:
+                        print(f"mp-server: {len(pending)} groups not "
+                              "ready after 300s", file=sys.stderr,
+                              flush=True)
+                        os._exit(3)
+                print(f"MPREADY {time.monotonic() - t0:.2f}", flush=True)
+            elif cmd == "SEAL":
+                server.seal_heap()
+                gc.enable()
+                print("MPSEALED", flush=True)
+            elif cmd == "RESET_TRACE":
+                from ratis_tpu.trace import get_tracer
+                get_tracer().reset()
+                print("MPTRACED", flush=True)
+            elif cmd == "REPORT":
+                report: dict = {
+                    "pid": os.getpid(),
+                    "engine": {k: server.engine.metrics.get(k, 0)
+                               for k in ("ticks", "batched_dispatches",
+                                         "commit_advances")},
+                    "append_rewinds":
+                        server.replication.metrics.get("rewinds", 0),
+                }
+                if spec.get("trace"):
+                    from ratis_tpu.trace import get_tracer
+                    from ratis_tpu.trace.export import \
+                        host_path_decomposition
+                    report["host_path_decomposition"] = \
+                        host_path_decomposition(get_tracer().snapshot())
+                print("MPREPORT " + json.dumps(report), flush=True)
+
+    asyncio.run(main())
+
+
+def _mp_client_main() -> None:
+    """One load-generator shard as its own process (``--mp-client``)."""
+    import json
+    import os
+
+    spec = json.loads(sys.stdin.readline())
+
+    async def main() -> None:
+        import ratis_tpu.transport.tcp  # noqa: F401
+        from ratis_tpu.transport.base import TransportFactory
+        transport = spec.get("transport", "tcp")
+        if transport == "grpc":
+            import ratis_tpu.transport.grpc  # noqa: F401
+        factory = TransportFactory.get(
+            "GRPC" if transport == "grpc" else "TCP")
+        # same wire/trace conf as the servers (flush keys, sampling)
+        properties = bench_properties(
+            spec.get("batched", True), len(spec["groups"]),
+            num_servers=len(spec["peers"]), transport=transport,
+            trace=spec.get("trace", False),
+            trace_sample=spec.get("trace_sample", 32))
+        # a client child builds no RaftServer, so the process tracer must
+        # be enabled explicitly or begin_trace() stays 0 and the whole
+        # cluster's per-request spans vanish
+        from ratis_tpu.trace import configure_from_properties
+        configure_from_properties(properties)
+        peers = [(RaftPeerId.value_of(pid), addr)
+                 for pid, addr in spec["peers"]]
+        by_id = dict(peers)
+        gids = [RaftGroupId.value_of(bytes.fromhex(h))
+                for h in spec["groups"]]
+        client = factory.new_client_transport(properties)
+        writes = spec["writes"]
+        sm = spec.get("sm", "counter")
+        if sm == "arithmetic":
+            seq = itertools.count()
+            mf = lambda: f"v{next(seq) % 7}={next(seq) % 97}+1".encode()
+        elif sm == "filestore":
+            import msgpack
+            seq = itertools.count()
+            mf = lambda: msgpack.packb(
+                {"op": "write", "path": f"mp{os.getpid()}-{next(seq)}",
+                 "data": b"x" * 128}, use_bin_type=True)
+        else:
+            mf = lambda: b"INCREMENT"
+        call_ids = itertools.count(1)
+        leader_hint: dict = {}
+        sem = asyncio.Semaphore(max(1, spec.get("concurrency", 32)))
+        latencies: list[float] = []
+        failures: list[str] = []
+        budget = 60.0 if len(gids) < 8192 else 240.0
+        from ratis_tpu.trace.tracer import STAGE_CLIENT, TRACER
+
+        async def one_write(client_id, gid, msg: bytes) -> None:
+            pid, addr = leader_hint.get(gid, peers[0])
+            deadline = time.monotonic() + budget
+            i = 0
+            while True:
+                trace_id = TRACER.begin_trace()
+                req = RaftClientRequest(client_id, pid, gid,
+                                        next(call_ids),
+                                        Message.value_of(msg),
+                                        type=write_request_type(),
+                                        timeout_ms=10_000.0,
+                                        trace_id=trace_id)
+                t0 = TRACER.now() if trace_id else 0
+                try:
+                    reply = await client.send_request(addr, req)
+                except (RaftException, asyncio.TimeoutError, OSError):
+                    reply = None
+                finally:
+                    if trace_id:
+                        TRACER.record(trace_id, STAGE_CLIENT, t0,
+                                      TRACER.now())
+                if reply is not None and reply.success:
+                    leader_hint[gid] = (pid, addr)
+                    return
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"write to {gid} kept failing")
+                exc = reply.exception if reply is not None else None
+                if isinstance(exc, NotLeaderException) \
+                        and exc.suggested_leader is not None \
+                        and exc.suggested_leader.id in by_id:
+                    pid = exc.suggested_leader.id
+                    addr = by_id[pid]
+                elif isinstance(exc, LeaderNotReadyException):
+                    await asyncio.sleep(0.01)
+                else:
+                    i += 1
+                    pid, addr = peers[i % len(peers)]
+                    await asyncio.sleep(0.01)
+
+        async def group_load(gid) -> None:
+            client_id = ClientId.random_id()
+            for _ in range(writes):
+                async with sem:
+                    t0 = time.monotonic()
+                    try:
+                        await one_write(client_id, gid, mf())
+                    except TimeoutError as e:
+                        failures.append(str(gid))
+                        print(f"mp-client: WRITE FAILED {gid}: {e}",
+                              file=sys.stderr, flush=True)
+                        continue
+                    latencies.append(time.monotonic() - t0)
+
+        wall_start = time.time()
+        t0 = time.monotonic()
+        await asyncio.gather(*(group_load(g) for g in gids))
+        elapsed = time.monotonic() - t0
+        out = {
+            "commits": len(latencies),
+            "failures": len(failures),
+            "elapsed_s": round(elapsed, 3),
+            "wall_start": wall_start,
+            "wall_end": time.time(),
+            "lat_ms": [round(x * 1e3, 1) for x in latencies],
+        }
+        if spec.get("trace"):
+            from ratis_tpu.trace import get_tracer
+            from ratis_tpu.trace.export import host_path_decomposition
+            out["client_decomp"] = host_path_decomposition(
+                get_tracer().snapshot())
+        print("MPRESULT " + json.dumps(out), flush=True)
+        os._exit(0)
+
+    asyncio.run(main())
+
+
+async def _mp_wait_line(proc, prefix: str, timeout_s: float, who: str) -> str:
+    """Read the child's stdout until a ``prefix`` line (stray lines pass
+    through to stderr so child diagnostics stay visible)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"{who}: no {prefix} within {timeout_s}s")
+        line = await asyncio.wait_for(proc.stdout.readline(), remaining)
+        if not line:
+            raise RuntimeError(f"{who} exited before {prefix} "
+                               f"(rc={proc.returncode})")
+        text = line.decode(errors="replace").rstrip()
+        if text.startswith(prefix):
+            return text
+        print(f"bench[{who}]: {text}", file=sys.stderr, flush=True)
+
+
+async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
+                              num_servers: int = 5,
+                              transport: str = "tcp",
+                              batched: bool = True,
+                              loop_shards: int = 1,
+                              client_procs: int = 4,
+                              concurrency: int = 128,
+                              sm: str = "counter",
+                              trace: bool = False,
+                              trace_sample: int = 32,
+                              bringup_timeout_s: float = 900.0,
+                              load_timeout_s: float = 1200.0) -> dict:
+    """The cluster as N server processes + M client processes over real
+    sockets; returns the same result-dict shape as :func:`run_bench` plus
+    an ``mp`` block."""
+    import json
+    import os
+
+    if transport not in ("tcp", "grpc"):
+        raise ValueError("multiproc bench needs a real-socket transport")
+    from ratis_tpu.protocol.ids import RaftGroupId as _Gid
+    peer_list = [[f"s{i}", f"127.0.0.1:{_ephemeral_port()}"]
+                 for i in range(num_servers)]
+    gids_hex = [_Gid.random_id().to_bytes().hex() for _ in range(num_groups)]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _repo_root() + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+
+    async def spawn(args: list[str], spec: dict):
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ratis_tpu.tools.bench_cluster", *args,
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
+            stderr=None, env=env, cwd=_repo_root(),
+            # an MPRESULT line carries every latency sample (hundreds of
+            # KB at 10k groups): the default 64KB StreamReader limit
+            # truncates it
+            limit=64 << 20)
+        proc.stdin.write((json.dumps(spec) + "\n").encode())
+        await proc.stdin.drain()
+        return proc
+
+    servers: list = []
+    clients: list = []
+    try:
+        for i in range(num_servers):
+            servers.append(await spawn(["--mp-server"], {
+                "peer_index": i, "peers": peer_list, "groups": gids_hex,
+                "batched": batched, "transport": transport, "sm": sm,
+                "loop_shards": loop_shards, "trace": trace,
+                "trace_sample": trace_sample}))
+        for i, proc in enumerate(servers):
+            await _mp_wait_line(proc, "MPSTARTED", bringup_timeout_s,
+                                f"server{i}")
+        for proc in servers:
+            proc.stdin.write(b"ADDGROUPS\n")
+            await proc.stdin.drain()
+        for i, proc in enumerate(servers):
+            await _mp_wait_line(proc, "MPADDED", bringup_timeout_s,
+                                f"server{i}")
+        t0 = time.monotonic()
+        servers[0].stdin.write(b"APPOINT\n")
+        await servers[0].stdin.drain()
+        ready = await _mp_wait_line(servers[0], "MPREADY",
+                                    bringup_timeout_s, "server0")
+        convergence_s = time.monotonic() - t0
+        for i, proc in enumerate(servers):
+            proc.stdin.write(b"SEAL\n")
+            await proc.stdin.drain()
+            await _mp_wait_line(proc, "MPSEALED", 120.0, f"server{i}")
+        if trace:
+            for i, proc in enumerate(servers):
+                proc.stdin.write(b"RESET_TRACE\n")
+                await proc.stdin.drain()
+                await _mp_wait_line(proc, "MPTRACED", 60.0, f"server{i}")
+
+        parts = [gids_hex[i::client_procs] for i in range(client_procs)]
+        parts = [pt for pt in parts if pt]
+        for i, part in enumerate(parts):
+            clients.append(await spawn(["--mp-client"], {
+                "peers": peer_list, "groups": part,
+                "writes": writes_per_group, "batched": batched,
+                "concurrency": max(1, concurrency // len(parts)),
+                "transport": transport, "sm": sm, "trace": trace,
+                "trace_sample": trace_sample}))
+        outs = []
+        for i, proc in enumerate(clients):
+            line = await _mp_wait_line(proc, "MPRESULT", load_timeout_s,
+                                       f"client{i}")
+            outs.append(json.loads(line[len("MPRESULT "):]))
+
+        total = num_groups * writes_per_group
+        commits = sum(o["commits"] for o in outs)
+        failures = sum(o["failures"] for o in outs)
+        lat = sorted(x for o in outs for x in o["lat_ms"])
+        if not lat or failures > max(8, total // 100):
+            raise TimeoutError(
+                f"{failures}/{total} multiproc writes failed")
+        # wall-clock over the union of the client windows (time.time() is
+        # process-shared; each child's import/startup cost stays outside)
+        elapsed = (max(o["wall_end"] for o in outs)
+                   - min(o["wall_start"] for o in outs))
+        n = len(lat)
+        result = {
+            "commits": commits,
+            "write_failures": failures,
+            "elapsed_s": round(elapsed, 3),
+            "commits_per_sec": round(commits / elapsed, 1),
+            "p50_ms": round(lat[n // 2], 2),
+            "p99_ms": round(lat[min(n - 1, (n * 99) // 100)], 2),
+            "election_convergence_s": round(convergence_s, 2),
+            "child_convergence_s": float(ready.split()[1]),
+            "prewarm_s": 0.0,
+            "groups": num_groups,
+            "mode": "batched" if batched else "scalar",
+            "transport": transport,
+            "peers": num_servers,
+            "mp": {"server_procs": num_servers,
+                   "client_procs": len(parts),
+                   "loop_shards": loop_shards},
+        }
+        servers[0].stdin.write(b"REPORT\n")
+        await servers[0].stdin.drain()
+        try:
+            rep = await _mp_wait_line(servers[0], "MPREPORT", 120.0,
+                                      "server0")
+            report = json.loads(rep[len("MPREPORT "):])
+            result["append_rewinds"] = report.get("append_rewinds", 0)
+            if trace and "host_path_decomposition" in report:
+                result["host_path_decomposition"] = \
+                    report["host_path_decomposition"]
+            if trace and outs and "client_decomp" in outs[0]:
+                result["client_decomp"] = outs[0]["client_decomp"]
+        except (TimeoutError, RuntimeError) as e:
+            print(f"bench: server0 report unavailable: {e}",
+                  file=sys.stderr, flush=True)
+        return result
+    finally:
+        for proc in (*servers, *clients):
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+        for proc in (*servers, *clients):
+            try:
+                await proc.wait()
+            except Exception:
+                pass
 
 
 @contextlib.asynccontextmanager
@@ -475,7 +1079,10 @@ async def _started_cluster(num_groups: int, batched: bool,
                            transport: str = "sim", sm: str = "counter",
                            datastream: bool = False, num_servers: int = 3,
                            hibernate: bool = False, mesh_devices: int = 0,
-                           trace: bool = False, trace_sample: int = 16):
+                           trace: bool = False, trace_sample: int = 16,
+                           loop_shards: int = 1,
+                           extra_props: Optional[dict] = None,
+                           sm_storage_root: Optional[str] = None):
     """Shared rung scaffold: build + start the cluster with the GC tuning
     every rung needs (defer gen-2 cascades during bring-up, then freeze the
     post-bring-up heap out of the collector — a single gen-2 pass over the
@@ -497,7 +1104,10 @@ async def _started_cluster(num_groups: int, batched: bool,
                                sm=sm, datastream=datastream,
                                hibernate=hibernate,
                                mesh_devices=mesh_devices,
-                               trace=trace, trace_sample=trace_sample)
+                               trace=trace, trace_sample=trace_sample,
+                               loop_shards=loop_shards,
+                               extra_props=extra_props,
+                               sm_storage_root=sm_storage_root)
         await cluster.start()
         cluster.servers[0].seal_heap()
         gc.enable()
@@ -516,7 +1126,9 @@ async def run_bench(num_groups: int, writes_per_group: int,
                     settle_s: float = 0.0, mesh_devices: int = 0,
                     teardown: bool = True, trace: bool = False,
                     trace_sample: int = 16,
-                    trace_out: "str | None" = None) -> dict:
+                    trace_out: "str | None" = None,
+                    loop_shards: int = 1,
+                    client_shards: int = 1) -> dict:
     """One ladder rung: build the ``num_servers``-server cluster, elect,
     warm up, measure, tear down.  ``teardown=False`` skips the graceful
     close: a measurement child that exits right after reporting has no
@@ -529,7 +1141,8 @@ async def run_bench(num_groups: int, writes_per_group: int,
     cm = _started_cluster(num_groups, batched, transport=transport,
                           sm=sm, num_servers=num_servers,
                           hibernate=hibernate, mesh_devices=mesh_devices,
-                          trace=trace, trace_sample=trace_sample)
+                          trace=trace, trace_sample=trace_sample,
+                          loop_shards=loop_shards)
     cluster = await cm.__aenter__()
     try:
         if hibernate and settle_s:
@@ -551,7 +1164,8 @@ async def run_bench(num_groups: int, writes_per_group: int,
             get_tracer().reset()
         result = await cluster.run_load(writes_per_group, concurrency,
                                         message_factory=mf,
-                                        active_groups=active_groups)
+                                        active_groups=active_groups,
+                                        client_shards=client_shards)
         if trace:
             from ratis_tpu.trace import get_tracer
             from ratis_tpu.trace.export import (host_path_decomposition,
@@ -596,6 +1210,8 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport
         result["peers"] = num_servers
+        if loop_shards > 1:
+            result["loop_shards"] = loop_shards
         if active_groups is not None:
             result["active_groups"] = active_groups
         if hibernate:
@@ -711,19 +1327,29 @@ async def run_churn_bench(num_groups: int, writes_per_group: int,
 async def run_mixed_bench(num_groups: int, writes_per_group: int,
                           streams: int, stream_bytes: int,
                           batched: bool = True,
-                          concurrency: int = 128) -> dict:
+                          concurrency: int = 128,
+                          num_servers: int = 3,
+                          transport: str = "sim",
+                          loop_shards: int = 1,
+                          client_shards: int = 1,
+                          stream_window: int = 16) -> dict:
     """BASELINE config 5 analog: filestore + DataStream mixed load.
 
     Every group runs a FileStore state machine; the bulk load is ordinary
     log-path file writes, while ``streams`` concurrent DataStream file
     streams (stream_bytes each) ride the out-of-band stream plane into a
-    subset of groups (ratis-examples filestore LoadGen's mixed mode)."""
+    subset of groups (ratis-examples filestore LoadGen's mixed mode).
+    With ``num_servers``/``transport`` at config 3's 5-peer real-TCP shape
+    this is the ``peer5_10240_filestore`` rung: the flagship workload
+    (FileStore SM + concurrent DataStream writes) at the flagship scale."""
     import msgpack
 
     from ratis_tpu.client import RaftClient
 
     async with _started_cluster(num_groups, batched, sm="filestore",
-                                datastream=True) as cluster:
+                                datastream=True, transport=transport,
+                                num_servers=num_servers,
+                                loop_shards=loop_shards) as cluster:
         stream_stats = {"ok": 0, "failed": 0, "bytes": 0, "elapsed_s": 0.0}
         payload = b"\x5a" * stream_bytes
 
@@ -739,7 +1365,8 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
                 cmd = msgpack.packb({"op": "stream",
                                      "path": f"stream-{i}.bin"},
                                     use_bin_type=True)
-                out = await client.data_stream().stream(cmd)
+                out = await client.data_stream().stream(
+                    cmd, window=stream_window)
                 for off in range(0, stream_bytes, 64 << 10):
                     await out.write_async(payload[off:off + (64 << 10)])
                 reply = await out.close_async()
@@ -783,10 +1410,15 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
             use_bin_type=True)
         stream_task = asyncio.create_task(stream_load())
         result = await cluster.run_load(writes_per_group, concurrency,
-                                        message_factory=msg_factory)
+                                        message_factory=msg_factory,
+                                        client_shards=client_shards)
         await stream_task
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"
+        result["transport"] = transport
+        result["peers"] = num_servers
+        if loop_shards > 1:
+            result["loop_shards"] = loop_shards
         result["streams_ok"] = stream_stats["ok"]
         result["streams_failed"] = stream_stats["failed"]
         result["stream_failures"] = stream_stats.get("failures", [])
@@ -794,6 +1426,219 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
             stream_stats["bytes"]
             / max(stream_stats["elapsed_s"], 1e-9) / (1 << 20), 2)
         return result
+
+
+async def run_read_write_bench(num_groups: int = 1024,
+                               writes_per_group: int = 4,
+                               reads_per_write: int = 3,
+                               batched: bool = True,
+                               concurrency: int = 128,
+                               transport: str = "tcp",
+                               num_servers: int = 3,
+                               loop_shards: int = 1) -> dict:
+    """Mixed read/write rung (VERDICT Missing #4): every write is chased by
+    three reads exercising the three read paths the server implements —
+
+    - a LINEARIZABLE read at the LEADER (raft.server.read.option=
+      LINEARIZABLE + leader lease: readIndex served from the lease when
+      valid, a confirmation round otherwise — LeaderLease.java:36 /
+      ReadIndexHeartbeats.java:40),
+    - a LINEARIZABLE read at a FOLLOWER (the follower asks the leader for
+      a readIndex and waits for local apply — readIndexAsync),
+    - a STALE read at a FOLLOWER (local state, no protocol).
+
+    Reports writes/s and reads/s (aggregate + per-path counts)."""
+    from ratis_tpu.protocol.requests import (read_request_type,
+                                             stale_read_request_type)
+
+    extra = {
+        RaftServerConfigKeys.Read.OPTION_KEY: "LINEARIZABLE",
+        RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY: "true",
+    }
+    async with _started_cluster(num_groups, batched, transport=transport,
+                                num_servers=num_servers,
+                                loop_shards=loop_shards,
+                                extra_props=extra) as cluster:
+        client = cluster.factory.new_client_transport(cluster.properties)
+        sem = asyncio.Semaphore(concurrency)
+        write_lat: list[float] = []
+        read_lat: list[float] = []
+        counts = {"lease_leader": 0, "follower_lin": 0, "stale": 0,
+                  "read_failures": 0}
+        failures: list[str] = []
+
+        async def one_read(client_id, g: RaftGroup, kind: str) -> None:
+            leader = cluster._leader_hint.get(g.group_id,
+                                              cluster.servers[0])
+            if kind == "lease_leader":
+                server = leader
+                rtype = read_request_type()
+            else:
+                others = [s for s in cluster.servers if s is not leader]
+                server = others[0] if others else leader
+                rtype = (read_request_type() if kind == "follower_lin"
+                         else stale_read_request_type(0))
+            req = RaftClientRequest(client_id, server.peer_id, g.group_id,
+                                    next(cluster._call_ids),
+                                    Message.value_of(b"GET"),
+                                    type=rtype, timeout_ms=15_000.0)
+            t0 = time.monotonic()
+            try:
+                reply = await client.send_request(server.address, req)
+            except (RaftException, asyncio.TimeoutError):
+                reply = None
+            if reply is not None and reply.success:
+                read_lat.append(time.monotonic() - t0)
+                counts[kind] += 1
+            else:
+                counts["read_failures"] += 1
+
+        async def group_load(g: RaftGroup) -> None:
+            client_id = ClientId.random_id()
+            for _ in range(writes_per_group):
+                async with sem:
+                    t0 = time.monotonic()
+                    try:
+                        await cluster._write(client, client_id, g.group_id)
+                    except TimeoutError:
+                        failures.append(str(g.group_id))
+                        continue
+                    write_lat.append(time.monotonic() - t0)
+                for kind in ("lease_leader", "follower_lin",
+                             "stale")[:reads_per_write]:
+                    async with sem:
+                        await one_read(client_id, g, kind)
+
+        t_start = time.monotonic()
+        await asyncio.gather(*(group_load(g) for g in cluster.groups))
+        elapsed = time.monotonic() - t_start
+        total_w = num_groups * writes_per_group
+        if not write_lat or len(failures) > max(8, total_w // 100):
+            raise TimeoutError(f"{len(failures)}/{total_w} writes failed")
+        reads_ok = len(read_lat)
+        if counts["read_failures"] > max(8, (reads_ok or 1) // 20):
+            raise TimeoutError(
+                f"{counts['read_failures']} reads failed "
+                f"(vs {reads_ok} ok) — the read paths are broken")
+        write_lat.sort()
+        read_lat.sort()
+        nw, nr = len(write_lat), len(read_lat)
+        return {
+            "commits": total_w - len(failures),
+            "write_failures": len(failures),
+            "elapsed_s": round(elapsed, 3),
+            "commits_per_sec": round((total_w - len(failures)) / elapsed, 1),
+            "reads_per_sec": round(reads_ok / elapsed, 1),
+            "reads_ok": reads_ok,
+            "read_failures": counts["read_failures"],
+            "reads_lease_leader": counts["lease_leader"],
+            "reads_follower_linearizable": counts["follower_lin"],
+            "reads_stale": counts["stale"],
+            "p50_ms": round(write_lat[nw // 2] * 1e3, 2),
+            "p99_ms": round(write_lat[min(nw - 1, (nw * 99) // 100)] * 1e3,
+                            2),
+            "read_p50_ms": round(read_lat[nr // 2] * 1e3, 2) if nr else None,
+            "read_p99_ms": (round(
+                read_lat[min(nr - 1, (nr * 99) // 100)] * 1e3, 2)
+                if nr else None),
+            "election_convergence_s": round(
+                cluster.election_convergence_s, 2),
+            "groups": num_groups,
+            "mode": "batched" if batched else "scalar",
+            "transport": transport,
+            "peers": num_servers,
+        }
+
+
+async def run_snapshot_catchup_bench(num_groups: int = 1024,
+                                     writes_per_group: int = 4,
+                                     batched: bool = True,
+                                     concurrency: int = 128,
+                                     transport: str = "tcp",
+                                     num_servers: int = 3,
+                                     loop_shards: int = 1) -> dict:
+    """InstallSnapshot-under-load rung (VERDICT Missing #5): seed every
+    group with writes, snapshot+purge the leaders' logs, WIPE one follower
+    server's replicas (group_remove + fresh group_add — the in-memory
+    analog of losing a disk), and measure the chunked-install catch-up
+    time while the cluster keeps serving writes.  Asserts the write path
+    does not collapse during installs (cps_during >= cps_before / 4 — a
+    collapse detector, not a noise gate)."""
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="ratis-snap-bench-")
+    async with _started_cluster(num_groups, batched, transport=transport,
+                                num_servers=num_servers,
+                                loop_shards=loop_shards,
+                                sm_storage_root=tmp) as cluster:
+        victim = cluster.servers[-1]
+        # seed: several committed entries per group so the purge leaves a
+        # real gap between a fresh log (next=0) and the leader's start
+        before = await cluster.run_load(writes_per_group, concurrency)
+
+        # snapshot + purge on every leader (the reference's
+        # SnapshotManagement path does exactly this per group)
+        snap_indexes: dict = {}
+        async def snap(g: RaftGroup):
+            leader = cluster._leader_hint.get(g.group_id,
+                                              cluster.servers[0])
+            d = leader.divisions[g.group_id]
+            idx = await d.take_snapshot_async()
+            snap_indexes[g.group_id] = idx
+        for i in range(0, len(cluster.groups), 256):
+            await asyncio.gather(*(snap(g)
+                                   for g in cluster.groups[i:i + 256]))
+        if not any(v >= 0 for v in snap_indexes.values()):
+            raise RuntimeError("no leader produced a snapshot")
+
+        # wipe the victim's replicas: remove + fresh re-add, in waves
+        t_wipe = time.monotonic()
+        for i in range(0, len(cluster.groups), 256):
+            batch = cluster.groups[i:i + 256]
+            await asyncio.gather(*(victim.group_remove(g.group_id)
+                                   for g in batch))
+            await asyncio.gather(*(victim.group_add(g) for g in batch))
+
+        # concurrent write load while the installs catch the victim up
+        load_task = asyncio.create_task(
+            cluster.run_load(writes_per_group, concurrency))
+        deadline = time.monotonic() + 600.0
+        pending = {g.group_id for g in cluster.groups
+                   if snap_indexes.get(g.group_id, -1) >= 0}
+        while pending and time.monotonic() < deadline:
+            caught = {gid for gid in pending
+                      if (d := victim.divisions.get(gid)) is not None
+                      and d._applied_index >= snap_indexes[gid]}
+            pending -= caught
+            if pending:
+                await asyncio.sleep(0.1)
+        catchup_s = time.monotonic() - t_wipe
+        during = await load_task
+        installed = sum(
+            1 for gid, idx in snap_indexes.items() if idx >= 0
+            and (d := victim.divisions.get(gid)) is not None
+            and d.state_machine.get_latest_snapshot() is not None)
+        if pending:
+            raise TimeoutError(
+                f"{len(pending)} groups never caught up after the wipe")
+        if during["commits_per_sec"] < before["commits_per_sec"] / 4:
+            raise RuntimeError(
+                "write path collapsed during snapshot installs: "
+                f"{during['commits_per_sec']} vs {before['commits_per_sec']}"
+                " before")
+        return {
+            "commits_per_sec": during["commits_per_sec"],
+            "cps_before": before["commits_per_sec"],
+            "p99_ms": during["p99_ms"],
+            "write_failures": (before["write_failures"]
+                               + during["write_failures"]),
+            "catchup_s": round(catchup_s, 2),
+            "installs": installed,
+            "groups": num_groups,
+            "transport": transport,
+            "peers": num_servers,
+            "election_convergence_s": round(
+                cluster.election_convergence_s, 2),
+        }
 
 
 async def run_stream_throughput_bench(streams: int, stream_mb: int,
@@ -860,3 +1705,15 @@ async def run_stream_throughput_bench(streams: int, stream_mb: int,
                 stats["bytes"] / max(elapsed, 1e-9) / (1 << 20), 2),
             "elapsed_s": round(elapsed, 2),
         }
+
+
+if __name__ == "__main__":
+    if "--mp-server" in sys.argv:
+        _mp_server_main()
+    elif "--mp-client" in sys.argv:
+        _mp_client_main()
+    else:
+        print("usage: python -m ratis_tpu.tools.bench_cluster "
+              "--mp-server|--mp-client  (spec JSON on stdin)",
+              file=sys.stderr)
+        sys.exit(2)

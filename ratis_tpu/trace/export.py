@@ -48,7 +48,8 @@ def host_path_decomposition(records) -> dict:
     by_stage: dict[int, list[int]] = {s: [] for s in range(NUM_STAGES)}
     client_wall: dict[int, int] = {}
     covered: dict[int, int] = {}
-    for tid, stage, _t0, dur, _tag in records:
+    for rec in records:
+        tid, stage, _t0, dur = rec[0], rec[1], rec[2], rec[3]
         by_stage[stage].append(dur)
         if stage == STAGE_CLIENT and tid:
             client_wall[tid] = client_wall.get(tid, 0) + dur
@@ -87,18 +88,28 @@ def to_chrome_trace(records) -> dict:
 
     One complete event per span; per-request spans land on a track (tid)
     per trace id so a request's stages read as one lane, process-level
-    spans (trace id 0) on track 0."""
+    spans (trace id 0) on track 0.  Every event carries the recording
+    process id and — when the runtime runs sharded event loops
+    (raft.tpu.server.loop-shards) — the origin loop thread, compressed to
+    a small per-process shard ordinal, so a cross-shard/cross-process
+    merge stays attributable."""
+    import os
+    pid = os.getpid()
     events = []
-    for tid, stage, t0, dur, tag in records:
+    shard_of: dict[int, int] = {}
+    for rec in records:
+        tid, stage, t0, dur, tag = rec[0], rec[1], rec[2], rec[3], rec[4]
+        origin = rec[5] if len(rec) > 5 else 0
+        shard = shard_of.setdefault(origin, len(shard_of)) if origin else 0
         events.append({
             "name": STAGE_NAMES[stage],
             "cat": "hostpath",
             "ph": "X",
             "ts": t0 / 1e3,         # microseconds since monotonic epoch
             "dur": max(dur, 1) / 1e3,
-            "pid": 1,
+            "pid": pid,
             "tid": tid,
-            "args": {"trace_id": tid, "tag": tag},
+            "args": {"trace_id": tid, "tag": tag, "loop_shard": shard},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import contextvars
 import itertools
+import threading
 import time
 
 import numpy as np
@@ -85,27 +86,34 @@ TILING_STAGES = (STAGE_ENCODE, STAGE_DECODE, STAGE_ROUTE, STAGE_TXN,
 class SpanRing:
     """Fixed-size span ring for ONE stage.
 
-    Records are rows of a pre-allocated ``[capacity, 4]`` int64 array
-    (trace_id, t0_ns, dur_ns, tag) — recording is one row assignment, no
-    allocation, and wraparound overwrites the oldest record."""
+    Records are rows of a pre-allocated ``[capacity, 5]`` int64 array
+    (trace_id, t0_ns, dur_ns, tag, origin_thread) — recording is one row
+    assignment, no allocation, and wraparound overwrites the oldest record.
+    With loop sharding (raft.tpu.server.loop-shards) stages record from
+    several event-loop threads into the same ring, so the row slot is
+    claimed under a lock and each span carries its origin thread id (the
+    Chrome export maps it to a per-shard track)."""
 
-    COLS = 4
+    COLS = 5
 
-    __slots__ = ("capacity", "_buf", "_n")
+    __slots__ = ("capacity", "_buf", "_n", "_lock")
 
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
         self._buf = np.zeros((self.capacity, self.COLS), np.int64)
         self._n = 0
+        self._lock = threading.Lock()
 
     def record(self, trace_id: int, t0_ns: int, t1_ns: int,
-               tag: int = 0) -> None:
-        row = self._buf[self._n % self.capacity]
+               tag: int = 0, origin: int = 0) -> None:
+        with self._lock:
+            row = self._buf[self._n % self.capacity]
+            self._n += 1
         row[0] = trace_id
         row[1] = t0_ns
         row[2] = t1_ns - t0_ns
         row[3] = tag
-        self._n += 1
+        row[4] = origin
 
     @property
     def count(self) -> int:
@@ -200,7 +208,8 @@ class Tracer:
                tag: int = 0) -> None:
         if not self.enabled:
             return
-        self._rings[stage].record(trace_id, t0_ns, t1_ns, tag)
+        self._rings[stage].record(trace_id, t0_ns, t1_ns, tag,
+                                  origin=threading.get_ident())
 
     def mark_egress(self, trace_id: int) -> None:
         """Server handler is done with this request NOW; the transport pops
@@ -220,12 +229,13 @@ class Tracer:
 
     # -- aggregation ---------------------------------------------------------
 
-    def snapshot(self) -> list[tuple[int, int, int, int, int]]:
-        """Every held record as (trace_id, stage, t0_ns, dur_ns, tag)."""
-        out: list[tuple[int, int, int, int, int]] = []
+    def snapshot(self) -> list[tuple[int, int, int, int, int, int]]:
+        """Every held record as
+        (trace_id, stage, t0_ns, dur_ns, tag, origin_thread)."""
+        out: list[tuple[int, int, int, int, int, int]] = []
         for stage, ring in enumerate(self._rings):
-            for tid, t0, dur, tag in ring.rows().tolist():
-                out.append((tid, stage, t0, dur, tag))
+            for tid, t0, dur, tag, origin in ring.rows().tolist():
+                out.append((tid, stage, t0, dur, tag, origin))
         return out
 
     def stage_dropped(self) -> dict[str, int]:

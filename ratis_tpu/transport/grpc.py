@@ -714,6 +714,10 @@ class GrpcServerTransport(ServerTransport):
         return server.add_insecure_port(address)
 
     async def start(self) -> None:
+        # grpc.aio channels/streams are hard-bound to the loop that created
+        # them; with server loop sharding, shard loops hop their sends here
+        # (see send_server_rpc) instead of dialing per-loop channels
+        self._home_loop = asyncio.get_running_loop()
         self._server = grpc.aio.server(options=_CHANNEL_OPTIONS)
         self._server.add_generic_rpc_handlers(self._generic_handlers())
         self._bound_port = self._bind(self._server, self._address)
@@ -807,6 +811,25 @@ class GrpcServerTransport(ServerTransport):
         return addr
 
     async def send_server_rpc(self, to: RaftPeerId, msg):
+        home = getattr(self, "_home_loop", None)
+        if home is not None:
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is not home:
+                # loop-sharded caller: grpc.aio state (channels, the shared
+                # bidi append streams, dial gates) lives on the home loop —
+                # hop there rather than duplicating C-core channels per
+                # shard.  The gRPC transport therefore serializes SENDS
+                # through one loop even when divisions are sharded; the TCP
+                # transport is the per-shard-pipe one.
+                cf = asyncio.run_coroutine_threadsafe(
+                    self._send_server_rpc_on_home(to, msg), home)
+                return await asyncio.wrap_future(cf)
+        return await self._send_server_rpc_on_home(to, msg)
+
+    async def _send_server_rpc_on_home(self, to: RaftPeerId, msg):
         address = self._resolve(to)
         # The DATA PLANE — entry-bearing appends and coalesced multi-group
         # envelopes — rides the long-lived per-peer bidi stream: one HTTP/2

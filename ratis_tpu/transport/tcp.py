@@ -169,8 +169,10 @@ class _Connection:
         self._out: Optional[_StreamFrameCoalescer] = None
         self._recv_task: Optional[asyncio.Task] = None
         self._dead: Optional[Exception] = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None  # at connect
 
     async def connect(self) -> None:
+        self.loop = asyncio.get_running_loop()
         host, port = self.address.rsplit(":", 1)
         ssl_ctx = self._tls.client_context() if self._tls is not None else None
         self._reader, self._writer = await asyncio.open_connection(
@@ -245,20 +247,31 @@ class _Connection:
 
 
 class _ConnectionPool:
-    """address -> cached connection; reconnects dead ones on demand."""
+    """(calling loop, address) -> cached connection; reconnects dead ones
+    on demand.
+
+    Keyed per loop on purpose: with loop sharding
+    (raft.tpu.server.loop-shards) divisions pinned to worker loops send
+    through this pool from their own threads, and an asyncio connection
+    (StreamWriter, drain waiters, recv task) is loop-affine — so each
+    shard dials its own connection per destination, which also gives each
+    shard an independent send pipe instead of one shared serialized
+    writer.  Single-loop runtimes see exactly the old one-connection-per-
+    address behavior."""
 
     def __init__(self, tls=None, flush_bytes: int = 0,
                  flush_micros: int = 0) -> None:
-        self._conns: Dict[str, _Connection] = {}
-        self._locks: Dict[str, asyncio.Lock] = {}
+        self._conns: Dict[tuple[int, str], _Connection] = {}
+        self._locks: Dict[tuple[int, str], asyncio.Lock] = {}
         self._tls = tls
         self._flush_bytes = flush_bytes
         self._flush_micros = flush_micros
 
     async def get(self, address: str) -> _Connection:
-        lock = self._locks.setdefault(address, asyncio.Lock())
+        key = (id(asyncio.get_running_loop()), address)
+        lock = self._locks.setdefault(key, asyncio.Lock())
         async with lock:
-            conn = self._conns.get(address)
+            conn = self._conns.get(key)
             if conn is not None and conn.alive:
                 return conn
             if conn is not None:
@@ -267,13 +280,34 @@ class _ConnectionPool:
                                flush_bytes=self._flush_bytes,
                                flush_micros=self._flush_micros)
             await conn.connect()
-            self._conns[address] = conn
+            self._conns[key] = conn
             return conn
 
     async def close(self) -> None:
-        for conn in self._conns.values():
-            await conn.close()
+        conns = list(self._conns.values())
         self._conns.clear()
+        self._locks.clear()
+        try:
+            current = asyncio.get_running_loop()
+        except RuntimeError:
+            current = None
+        for conn in conns:
+            if conn.loop is None or conn.loop is current:
+                await conn.close()
+            elif conn.loop.is_running():
+                # shard-owned connection: its recv task and writer must be
+                # unwound on the loop they live on
+                try:
+                    await asyncio.wrap_future(
+                        asyncio.run_coroutine_threadsafe(conn.close(),
+                                                         conn.loop))
+                except Exception:
+                    pass  # connection already broken; socket dies with it
+            else:
+                # owner loop gone (test teardown): close the raw transport
+                # so the fd is released; tasks on the dead loop never run
+                if conn._writer is not None:
+                    conn._writer.close()
 
 
 class TcpServerTransport(ServerTransport):

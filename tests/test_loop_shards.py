@@ -1,0 +1,199 @@
+"""Host-runtime loop sharding (raft.tpu.server.loop-shards) and the
+multi-process cluster harness.
+
+Covers the three contracts the sharded runtime adds on top of the
+single-loop one: stable division->shard placement with cross-shard request
+routing, thread-safe engine event intake from worker loops, and the
+subprocess cluster's lifecycle (spawn -> bring-up -> load -> teardown)."""
+
+import asyncio
+import threading
+
+import pytest
+
+from ratis_tpu.server.shards import LoopShardPool
+
+
+# ------------------------------------------------------------- shard pool
+
+def test_shard_pool_placement_stable_and_spread():
+    pool = LoopShardPool("t", 4)
+    keys = [bytes([i, i ^ 7, 3 * i % 251, 99]) * 4 for i in range(64)]
+    first = [pool.shard_of(k) for k in keys]
+    assert first == [pool.shard_of(k) for k in keys], "placement not stable"
+    assert all(0 <= s < 4 for s in first)
+    assert len(set(first)) > 1, "hash pin never spread across shards"
+
+
+def test_shard_pool_run_on_executes_on_owning_loop():
+    async def body():
+        pool = LoopShardPool("t", 3)
+        pool.start()
+        try:
+            primary = asyncio.get_running_loop()
+            assert pool.loop(0) is primary
+
+            async def where():
+                return asyncio.get_running_loop()
+
+            for idx in range(3):
+                loop = await pool.run_on(idx, where())
+                assert loop is pool.loop(idx)
+            # exceptions propagate through the cross-loop hop unchanged
+            async def boom():
+                raise ValueError("crossed")
+
+            with pytest.raises(ValueError, match="crossed"):
+                await pool.run_on(1, boom())
+        finally:
+            await pool.close()
+        assert not pool.started
+
+    asyncio.run(body())
+
+
+def test_shard_pool_close_joins_threads():
+    async def body():
+        pool = LoopShardPool("t", 3)
+        pool.start()
+        threads = list(pool._threads)
+        assert all(t.is_alive() for t in threads)
+        await pool.close()
+        assert all(not t.is_alive() for t in threads)
+
+    asyncio.run(body())
+
+
+# ------------------------------------------- thread-safe engine intake
+
+def test_engine_intake_from_worker_threads():
+    """Shard loops call on_ack/on_flush/on_deadline from their own
+    threads while the tick task runs on the home loop: the rings and the
+    host mirror must stay coherent (no lost swaps, no torn state)."""
+    from ratis_tpu.engine.engine import QuorumEngine
+
+    async def body():
+        eng = QuorumEngine(max_groups=64, max_peers=8,
+                           tick_interval_s=0.001,
+                           scalar_fallback_threshold=10**9)
+
+        class Listener:
+            async def on_election_timeout(self):
+                pass
+
+            async def on_commit_advance(self, c):
+                pass
+
+            async def on_leadership_stale(self):
+                pass
+
+        slots = [eng.attach(Listener()) for _ in range(8)]
+        await eng.start()
+        try:
+            iters = 400
+
+            def hammer(k: int) -> None:
+                for i in range(iters):
+                    for slot in slots:
+                        eng.on_ack(slot, (k + 1) % 8, i)
+                        eng.on_flush(slot, i)
+                        eng.on_deadline(slot, 1 << 29)
+
+            await asyncio.gather(
+                *(asyncio.to_thread(hammer, k) for k in range(4)))
+            # let the tick drain what the threads queued
+            for _ in range(50):
+                await asyncio.sleep(0.005)
+                if not eng._ack_ring and not eng._slot_updates:
+                    break
+            assert not eng._ack_ring, "ack ring never drained"
+            s = eng.state
+            for slot in slots:
+                # every slot saw the max flush the threads pushed
+                assert int(s.flush_index[slot]) == iters - 1
+            assert eng.metrics["acks"] == 4 * iters * len(slots), \
+                "intake lost acks across threads"
+        finally:
+            await eng.close()
+            for slot in slots:
+                eng.detach(slot)
+
+    asyncio.run(body())
+
+
+# -------------------------------------------------- sharded cluster e2e
+
+def test_sharded_cluster_routes_and_pins_divisions():
+    """A loop-sharded server must (a) spread divisions across shards,
+    (b) run each division's machinery ON its pinned loop, and (c) serve
+    cross-shard client/server traffic correctly end to end."""
+    from ratis_tpu.tools.bench_cluster import BenchCluster
+
+    async def body():
+        cluster = BenchCluster(8, num_servers=3, batched=False,
+                               transport="tcp", loop_shards=2)
+        await cluster.start()
+        try:
+            s0 = cluster.servers[0]
+            assert s0.shards is not None and s0.shards.n == 2
+            placed = {s0.shard_of_group(g.group_id)
+                      for g in cluster.groups}
+            assert len(placed) > 1, "8 groups all hashed to one shard"
+            for g in cluster.groups:
+                d = s0.divisions[g.group_id]
+                idx = s0.shard_of_group(g.group_id)
+                # the apply loop (the division's standing task) lives on
+                # the pinned loop
+                assert d._apply_task.get_loop() is s0.shards.loop(idx)
+            out = await cluster.run_load(2, concurrency=8)
+            assert out["write_failures"] == 0
+            assert out["commits"] == 8 * 2
+        finally:
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+def test_sharded_client_driver_over_tcp():
+    """client_shards: the load generator split across threads/loops with
+    independent connections produces the same commits, and loop-shards=1
+    + client_shards=1 still goes through the unsharded code path."""
+    from ratis_tpu.tools.bench_cluster import run_bench
+
+    async def body():
+        out = await run_bench(4, 3, batched=False, concurrency=8,
+                              transport="tcp", warmup_writes=0,
+                              loop_shards=2, client_shards=2)
+        assert out["write_failures"] == 0
+        assert out["commits"] == 12
+        assert out["client_shards"] == 2
+        assert out["loop_shards"] == 2
+
+    asyncio.run(body())
+
+
+# ----------------------------------------------- multi-process harness
+
+def test_multiproc_cluster_lifecycle():
+    """Spawn a real 3-process cluster + 2 client processes, push writes
+    through it, and verify the harness tears every child down."""
+    from ratis_tpu.tools.bench_cluster import run_multiproc_bench
+
+    async def body():
+        out = await run_multiproc_bench(
+            4, 2, num_servers=3, transport="tcp", loop_shards=2,
+            client_procs=2, concurrency=8, bringup_timeout_s=420.0,
+            load_timeout_s=300.0)
+        assert out["write_failures"] == 0
+        assert out["commits"] == 8
+        assert out["mp"] == {"server_procs": 3, "client_procs": 2,
+                             "loop_shards": 2}
+        assert out["commits_per_sec"] > 0
+        return out
+
+    asyncio.run(body())
+    # teardown proof: no stray --mp-server/--mp-client children survive
+    import subprocess
+    ps = subprocess.run(["ps", "ax"], capture_output=True, text=True)
+    assert "--mp-server" not in ps.stdout
+    assert "--mp-client" not in ps.stdout

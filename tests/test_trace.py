@@ -85,7 +85,7 @@ def test_span_propagation_sim_transport_end_to_end():
     run_with_new_cluster(3, body, properties=fast_properties())
 
     by_stage: dict[int, set[int]] = {}
-    for tid, stage, _t0, _dur, _tag in tracer.snapshot():
+    for tid, stage, _t0, _dur, _tag, _origin in tracer.snapshot():
         if tid:
             by_stage.setdefault(stage, set()).add(tid)
     client_ids = by_stage.get(STAGE_CLIENT, set())

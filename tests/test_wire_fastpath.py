@@ -371,7 +371,7 @@ def test_trace_attribution_survives_coalescing():
         run_with_new_cluster(3, t, rpc_type=RPC,
                              properties=_coalescing_properties())
         by_stage: dict[int, set[int]] = {}
-        for tid, stage, _t0, _dur, _tag in tracer.snapshot():
+        for tid, stage, _t0, _dur, _tag, _origin in tracer.snapshot():
             if tid:
                 by_stage.setdefault(stage, set()).add(tid)
         full = (by_stage.get(STAGE_CLIENT, set())
@@ -413,7 +413,10 @@ def test_bench_summary_line_fits_driver_window():
         ladder={1: trials[:2], 64: trials[:2], 1024: trials[:3],
                 10_240: trials[:2]},
         mesh_trials=trials[:2],
-        peer5=rung(host_path_decomposition=decomp),
+        peer5=rung(host_path_decomposition=decomp,
+                   mp={"server_procs": 5, "client_procs": 4,
+                       "loop_shards": 3}),
+        peer5_sp=rung(), peer5_mp=rung(),
         peer5_scalar=rung(),
         peer5_grpc=rung(), peer5_grpc_scalar=rung(),
         peer7=rung(host_path_decomposition=decomp),
@@ -426,11 +429,22 @@ def test_bench_summary_line_fits_driver_window():
                 "vs_scalar_loop": 99126.85, "platform": "TPU v5 lite0"},
         kernel_100k={"group_updates_per_sec_100k": 1333027867.0},
         tpu_e2e={"dnf": True, "reason": "x" * 500},
-        traced=rung(host_path_decomposition=decomp))
+        traced=rung(host_path_decomposition=decomp),
+        filestore5=rung(streams_ok=32, stream_mb_per_s=99999.99),
+        readmix=rung(reads_per_sec=123456.8, read_p99_ms=99999.99,
+                     reads_lease_leader=99999,
+                     reads_follower_linearizable=99999,
+                     reads_stale=99999),
+        snapcatch=rung(catchup_s=9999.99, installs=10240,
+                       cps_before=123456.8))
     line = json.dumps(summary, separators=(",", ":"))
     assert len(line) < 2000, f"bench line would overflow: {len(line)} chars"
     parsed = json.loads(line)
     assert parsed["value"] == 123456.8
     assert parsed["vs_baseline"] == 1.0
     assert parsed["secondary"]["peer5_10240"]["vs_scalar"] == 1.0
+    assert parsed["secondary"]["peer5_10240"]["mp"] == [5, 3, 4]
+    assert parsed["secondary"]["p5_fs"][2] == 32
+    assert parsed["secondary"]["readmix"][1] == 123456.8
+    assert parsed["secondary"]["snap_1024"][1] == 10240
     assert "batched_commits_per_sec" in parsed["secondary"]["grpc_1024"]
