@@ -794,6 +794,16 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
             "spread_b": _spread(headline_cps),
             "spread_s": _spread(scalar_cps),
             "wf": wf,
+            # observability plane: [engine group-lane occupancy at the
+            # headline shape (live rows / padded capacity — the "are we
+            # actually batching" signal), watchdog events across the
+            # headline + flagship rungs (0 = no stall/churn/lag detected
+            # while the numbers above were measured)]
+            "obs": [_median([t.get("engine_occupancy", 0.0)
+                             for t in headline]),
+                    sum(t.get("watchdog_events", 0) for t in headline)
+                    + (peer5.get("watchdog_events", 0)
+                       if isinstance(peer5, dict) else 0)],
             "scalar_mode_commits_per_sec": _median(scalar_cps),
             "peer5_10240": {
                 "commits_per_sec": peer5["commits_per_sec"],

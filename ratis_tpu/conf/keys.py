@@ -418,6 +418,80 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Hibernate.BACKSTOP_KEY,
                 RaftServerConfigKeys.Hibernate.BACKSTOP_DEFAULT)
 
+    class Metrics:
+        """Per-server introspection endpoint (the cluster observability
+        plane's scrape surface; no 1:1 reference analog — the reference
+        exposes dropwizard reporters, operators today scrape Prometheus).
+        When the port key is SET the server serves ``GET /metrics``
+        (Prometheus text), ``/health`` (liveness + engine tick freshness),
+        ``/divisions`` (per-division introspection JSON), and ``/events``
+        (the stall watchdog's journal) on 127.0.0.1.  ``0`` binds an
+        ephemeral port (the multi-process bench children use it and report
+        the bound port to the parent); UNSET (the default) opens no
+        listener socket and leaves the request hot paths untouched."""
+
+        HTTP_PORT_KEY = "raft.tpu.metrics.http-port"
+
+        @staticmethod
+        def http_port(p: RaftProperties) -> "int | None":
+            v = p.get(RaftServerConfigKeys.Metrics.HTTP_PORT_KEY)
+            return None if v in (None, "") else int(v)
+
+    class Watchdog:
+        """Stall watchdog (ratis_tpu.server.watchdog; no reference analog —
+        the closest shape is Borgmon-style derived alerting): a per-server
+        sampling task detecting commit-stall (commitIndex flat while
+        pending requests > 0), election churn, and follower lag beyond a
+        threshold.  Detections append structured events to a bounded ring
+        journal served at ``GET /events`` and surfaced by the shell's
+        ``health`` subcommand.  Pure background sampling — nothing on the
+        request path."""
+
+        ENABLED_KEY = "raft.tpu.watchdog.enabled"
+        ENABLED_DEFAULT = True
+        INTERVAL_KEY = "raft.tpu.watchdog.interval"
+        INTERVAL_DEFAULT = TimeDuration.valueOf("1s")
+        JOURNAL_SIZE_KEY = "raft.tpu.watchdog.journal-size"
+        JOURNAL_SIZE_DEFAULT = 256
+        # follower match-index lag (entries behind the leader commit)
+        # beyond which a follower-lag event is journaled
+        FOLLOWER_LAG_KEY = "raft.tpu.watchdog.follower-lag-threshold"
+        FOLLOWER_LAG_DEFAULT = 4096
+        # election timeouts + started elections per sampling interval
+        # (server-wide) beyond which an election-churn event is journaled
+        CHURN_KEY = "raft.tpu.watchdog.churn-threshold"
+        CHURN_DEFAULT = 8
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Watchdog.ENABLED_KEY,
+                RaftServerConfigKeys.Watchdog.ENABLED_DEFAULT)
+
+        @staticmethod
+        def interval(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Watchdog.INTERVAL_KEY,
+                RaftServerConfigKeys.Watchdog.INTERVAL_DEFAULT)
+
+        @staticmethod
+        def journal_size(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Watchdog.JOURNAL_SIZE_KEY,
+                RaftServerConfigKeys.Watchdog.JOURNAL_SIZE_DEFAULT)
+
+        @staticmethod
+        def follower_lag_threshold(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Watchdog.FOLLOWER_LAG_KEY,
+                RaftServerConfigKeys.Watchdog.FOLLOWER_LAG_DEFAULT)
+
+        @staticmethod
+        def churn_threshold(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Watchdog.CHURN_KEY,
+                RaftServerConfigKeys.Watchdog.CHURN_DEFAULT)
+
     class PauseMonitor:
         """Event-loop pause monitor (reference JvmPauseMonitor.java:38)."""
 

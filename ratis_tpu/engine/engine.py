@@ -82,6 +82,126 @@ def _shared_fast_step():
     return _SHARED_FAST_STEP
 
 
+# Why the sweep gate let a batched dispatch through — the dispatch count at
+# scale is THE batched-mode cost driver, so its composition is a first-class
+# labeled counter set instead of a guess.
+DISPATCH_REASONS = ("upload", "commit", "dirty", "votes", "sweep", "backlog")
+
+
+class EngineMetrics:
+    """The engine's observability surface: a real ``RatisMetricRegistry``
+    ("engine" component) instead of the plain dict of earlier rounds.
+
+    Carries what the dict could not express: a per-sweep dispatch-latency
+    timer (host -> XLA -> host wall per batched dispatch), batch
+    lane-occupancy gauges (live rows vs padded capacity per packed tensor
+    — the "are we actually batching" TPU signal), an ack-batch size
+    histogram, and the per-reason dispatch counters as labeled counters.
+    The old dict keys stay readable through :class:`_EngineMetricsView`
+    (``engine.metrics``) for bench/test compatibility."""
+
+    def __init__(self, engine: "QuorumEngine", prefix: str) -> None:
+        from ratis_tpu.metrics.registry import (MetricRegistries,
+                                                MetricRegistryInfo, labeled)
+        info = MetricRegistryInfo(prefix=prefix, application="ratis",
+                                  component="engine", name="quorum_engine")
+        self.registry = MetricRegistries.global_registries().create(info)
+        r = self.registry
+        # the historical dict keys, now real counters (names preserved so
+        # the scrape and the dict view agree)
+        self.ticks = r.counter("ticks")
+        self.acks = r.counter("acks")
+        self.commit_advances = r.counter("commit_advances")
+        self.batched_dispatches = r.counter("batched_dispatches")
+        self.refresh_rows = r.counter("refresh_rows")
+        self.fast_ticks = r.counter("fast_ticks")
+        self.refresh_ticks = r.counter("refresh_ticks")
+        self.idle_skips = r.counter("idle_skips")
+        self.reasons = {reason: r.counter(labeled("dispatches",
+                                                  reason=reason))
+                        for reason in DISPATCH_REASONS}
+        # host->XLA->host wall clock of one batched dispatch (upload +
+        # kernel + output download), and the packed ack batch it carried
+        self.dispatch_timer = r.timer("dispatchLatency")
+        self.ack_batch = r.histogram("ackBatchSize")
+        # Lane occupancy: live rows vs padded lane capacity for the two
+        # packed tensors the kernel consumes — the [G, P] group batch and
+        # the [7, E] event pack of the LAST dispatch.  Occupancy near 0
+        # means the server pays full-width dispatches for a few live lanes.
+        r.gauge("laneGroupsLive", lambda: len(engine.state.active))
+        r.gauge("laneGroupsCapacity", lambda: engine.state.capacity)
+        r.gauge("laneOccupancyGroups",
+                lambda: len(engine.state.active) / engine.state.capacity)
+        r.gauge("laneEventsLastDispatch", lambda: engine._last_event_rows)
+        r.gauge("laneEventCapacityLastDispatch",
+                lambda: engine._last_event_cap)
+        r.gauge("laneOccupancyEvents",
+                lambda: (engine._last_event_rows / engine._last_event_cap
+                         if engine._last_event_cap else 0.0))
+
+    def unregister(self) -> None:
+        from ratis_tpu.metrics.registry import MetricRegistries
+        MetricRegistries.global_registries().remove(self.registry.info)
+
+
+class _EngineMetricsView:
+    """Dict-shaped read view over :class:`EngineMetrics` — the
+    ``engine.metrics`` the bench and tests already consume.  Supports
+    ``m["ticks"]``, ``m.get``, iteration, and ``m[k] = v`` (tests reset
+    counters through it); the per-reason dispatch counters appear under
+    their historical ``dispatch_<reason>`` keys only once non-zero, like
+    the dict they replace."""
+
+    _PLAIN = ("ticks", "acks", "commit_advances", "batched_dispatches",
+              "refresh_rows", "fast_ticks", "refresh_ticks", "idle_skips")
+
+    def __init__(self, em: EngineMetrics) -> None:
+        self._em = em
+
+    def _counter(self, key: str):
+        if key in self._PLAIN:
+            return getattr(self._em, key)
+        if key.startswith("dispatch_"):
+            return self._em.reasons.get(key[len("dispatch_"):])
+        return None
+
+    def __getitem__(self, key: str) -> int:
+        c = self._counter(key)
+        if c is None:
+            raise KeyError(key)
+        return c.count
+
+    def __setitem__(self, key: str, value: int) -> None:
+        c = self._counter(key)
+        if c is None:
+            raise KeyError(key)
+        c._value = int(value)
+
+    def get(self, key: str, default=None):
+        c = self._counter(key)
+        return default if c is None else c.count
+
+    def __contains__(self, key: str) -> bool:
+        return self._counter(key) is not None
+
+    def keys(self) -> list[str]:
+        return [*self._PLAIN,
+                *(f"dispatch_{r}" for r, c in self._em.reasons.items()
+                  if c.count)]
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def items(self):
+        return [(k, self[k]) for k in self.keys()]
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+    def __repr__(self) -> str:
+        return repr(dict(self.items()))
+
+
 class EngineListener(Protocol):
     """What a division implements to be driven by the engine."""
 
@@ -120,7 +240,8 @@ class QuorumEngine:
                  scalar_fallback_threshold: int = 16,
                  leadership_timeout_ms: int = 300,
                  use_device: bool = False,
-                 mesh=None, profile_dir: Optional[str] = None):
+                 mesh=None, profile_dir: Optional[str] = None,
+                 name: str = ""):
         # Optional jax.sharding.Mesh: the PRODUCTION resident tick
         # (engine_step_resident / _fast, donated DeviceState) runs sharded
         # over the group axis — each device owns G/n rows, packed events
@@ -166,9 +287,18 @@ class QuorumEngine:
         # largest compiled event bucket (lowered by prewarm): dispatch
         # chunks never exceed it, so no fresh jit shape mid-run
         self._event_bucket_cap = self._MAX_EVENT_BUCKET
-        self.metrics = {"ticks": 0, "acks": 0, "commit_advances": 0,
-                        "batched_dispatches": 0, "refresh_rows": 0,
-                        "fast_ticks": 0, "refresh_ticks": 0, "idle_skips": 0}
+        # last-dispatch packed-event lane fill (read by the occupancy
+        # gauges; see EngineMetrics)
+        self._last_event_rows = 0
+        self._last_event_cap = 0
+        # monotonic time of the last completed tick (engine freshness for
+        # the /health endpoint); None until the loop runs once
+        self.last_tick_monotonic: Optional[float] = None
+        # Real metric registry ("engine" component); engine.metrics keeps
+        # the historical dict read surface over it.
+        self._m = EngineMetrics(
+            self, name or f"engine-{id(self):x}")
+        self.metrics = _EngineMetricsView(self._m)
         # Cross-shard intake safety (raft.tpu.server.loop-shards): divisions
         # pinned to worker event loops call the intake methods from their
         # own threads while the tick task reads/swaps the same rings and
@@ -254,7 +384,7 @@ class QuorumEngine:
             int(s.first_leader_index[slot]), True)
         if did:
             s.commit_index[slot] = new_commit
-            self.metrics["commit_advances"] += 1
+            self._m.commit_advances.inc()
             cb(new_commit)
 
     def on_flush(self, slot: int, flush_index: int) -> None:
@@ -512,6 +642,10 @@ class QuorumEngine:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # drop the engine registry from the global scrape surface; the
+        # counters stay readable through engine.metrics (tests inspect a
+        # closed cluster's engines)
+        self._m.unregister()
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
@@ -540,10 +674,11 @@ class QuorumEngine:
                 # sequences would make xprof's per-step view meaningless.
                 import jax
                 with jax.profiler.StepTraceAnnotation(
-                        "engine_tick", step_num=self.metrics["ticks"]):
+                        "engine_tick", step_num=self._m.ticks.count):
                     await self.tick()
             else:
                 await self.tick()
+            self.last_tick_monotonic = loop.time()
             cost = loop.time() - t0
             if cost > self.tick_interval_s:
                 # Self-pacing: a dispatch that cost more than the tick
@@ -610,7 +745,7 @@ class QuorumEngine:
             if listener is None:
                 continue
             if kind == "commit":
-                self.metrics["commit_advances"] += 1
+                self._m.commit_advances.inc()
                 coro = listener.on_commit_advance(value)
             elif kind == "timeout":
                 coro = listener.on_election_timeout()
@@ -633,7 +768,7 @@ class QuorumEngine:
         (changed listener events, resolved vote futures)."""
         s = self.state
         now = self._maybe_rebase_epoch(self.clock.now_ms())
-        self.metrics["ticks"] += 1
+        self._m.ticks.inc()
 
         active = s.active
         if not active:
@@ -656,29 +791,28 @@ class QuorumEngine:
             # due.  Let events accumulate — the next dispatch carries a
             # bigger packed batch (the shape the kernel wants) and the
             # engine's dispatch rate drops from per-tick to per-sweep.
-            self.metrics["idle_skips"] += 1
+            self._m.idle_skips.inc()
             return [], []
         if use_batched:
-            # why did the gate let this dispatch through? (the dispatch
-            # count at scale is THE batched-mode cost driver; this makes
-            # its composition observable instead of guessed at)
-            m = self.metrics
+            # why did the gate let this dispatch through? (the labeled
+            # dispatches{reason=...} counters; see EngineMetrics)
+            reasons = self._m.reasons
             if self._dev is None:
-                m["dispatch_upload"] = m.get("dispatch_upload", 0) + 1
+                reasons["upload"].inc()
             elif self._tick_commit_pending:
-                m["dispatch_commit"] = m.get("dispatch_commit", 0) + 1
+                reasons["commit"].inc()
             elif s.dirty:
-                m["dispatch_dirty"] = m.get("dispatch_dirty", 0) + 1
+                reasons["dirty"].inc()
             elif self._vote_rounds or self._vote_ring:
-                m["dispatch_votes"] = m.get("dispatch_votes", 0) + 1
+                reasons["votes"].inc()
             elif now >= self._next_sweep_ms:
-                m["dispatch_sweep"] = m.get("dispatch_sweep", 0) + 1
+                reasons["sweep"].inc()
             else:
-                m["dispatch_backlog"] = m.get("dispatch_backlog", 0) + 1
+                reasons["backlog"].inc()
 
         acks = self._ack_ring
         self._ack_ring = []
-        self.metrics["acks"] += len(acks)
+        self._m.acks.inc(len(acks))
 
         # The host mirror was updated eagerly at ack intake (on_ack), where
         # the commit advance now happens inline; the events still travel to
@@ -872,6 +1006,7 @@ class QuorumEngine:
         layout documented at ops.quorum.engine_step_resident_fast)."""
         n = len(acks) + len(updates)
         ecap = self._bucket(n)
+        self._last_event_rows, self._last_event_cap = n, ecap
         evp = np.full((7, ecap), _PACK_SENTINEL, np.int32)
         evp[0] = 0
         evp[1] = 0
@@ -926,10 +1061,19 @@ class QuorumEngine:
         return changed
 
     def _tick_batched_pass(self, acks, now: int) -> list[tuple[int, str, int]]:
+        # dispatch-latency timer: host -> XLA -> host wall for this sweep
+        # (pack + upload + kernel + output download), recorded even on an
+        # exception path so a wedged backend shows up in the p99
+        with self._m.dispatch_timer.time():
+            self._m.ack_batch.update(len(acks))
+            return self._tick_batched_dispatch(acks, now)
+
+    def _tick_batched_dispatch(self, acks, now: int
+                               ) -> list[tuple[int, str, int]]:
         import jax.numpy as jnp
 
         s = self.state
-        self.metrics["batched_dispatches"] += 1
+        self._m.batched_dispatches.inc()
         # engine.dispatch host-path span (process-level, sampled): the
         # device round-trip cost per dispatch, tag = packed event count
         trace_t0 = (TRACER.now()
@@ -948,7 +1092,7 @@ class QuorumEngine:
             # small transfers costing more than the quorum math itself.
             # Flush advances and deadline re-arms travel as packed updates
             # alongside the acks, so routine traffic never needs a refresh.
-            self.metrics["fast_ticks"] += 1
+            self._m.fast_ticks.inc()
             step = self._fast_kernel()
             updates, self._slot_updates = self._slot_updates, {}
             res = step(self._dev, jnp.asarray(self._pack_tick(acks, updates)),
@@ -966,11 +1110,11 @@ class QuorumEngine:
         # dirty-row refresh: O(changed slots) host->device.  Slots with
         # queued packed updates fold in here — the mirror already holds
         # their values, so the row refresh carries them.
-        self.metrics["refresh_ticks"] += 1
+        self._m.refresh_ticks.inc()
         dirty = sorted(s.dirty | set(self._slot_updates))
         self._slot_updates.clear()
         s.dirty.clear()
-        self.metrics["refresh_rows"] += len(dirty)
+        self._m.refresh_rows.inc(len(dirty))
         dcap = self._bucket(len(dirty))
         # padded entries point one past the end -> dropped by the scatter
         rf_idx = np.full(dcap, s.capacity, np.int32)
@@ -979,6 +1123,7 @@ class QuorumEngine:
 
         # packed ack events: O(events) host->device
         ecap = self._bucket(len(acks))
+        self._last_event_rows, self._last_event_cap = len(acks), ecap
         evg = np.zeros(ecap, np.int32)
         evp = np.zeros(ecap, np.int32)
         evm = np.zeros(ecap, np.int32)

@@ -11,9 +11,11 @@ import asyncio
 import json
 import sys
 
-from ratis_tpu.metrics.registry import (Counter, MetricRegistries,
+from ratis_tpu.metrics.registry import (Counter, Histogram,
+                                        MetricRegistries,
                                         MetricRegistryInfo,
-                                        RatisMetricRegistry, Timekeeper)
+                                        RatisMetricRegistry, Timekeeper,
+                                        labeled)
 from ratis_tpu.metrics.server_metrics import (DataStreamMetrics,
                                               LeaderElectionMetrics,
                                               LogAppenderMetrics,
@@ -23,7 +25,8 @@ from ratis_tpu.metrics.server_metrics import (DataStreamMetrics,
                                               StateMachineMetrics)
 
 __all__ = [
-    "Counter", "MetricRegistries", "MetricRegistryInfo",
+    "Counter", "Histogram", "labeled", "MetricRegistries",
+    "MetricRegistryInfo",
     "RatisMetricRegistry", "Timekeeper", "RaftServerMetrics",
     "LeaderElectionMetrics", "SegmentedRaftLogMetrics", "LogWorkerMetrics",
     "LogAppenderMetrics", "StateMachineMetrics", "DataStreamMetrics",

@@ -1,0 +1,191 @@
+"""Cross-process metrics aggregation for the observability plane.
+
+PR 3 split the bench cluster into one process per peer; each child owns
+its own metric registries, tracer rings, and engine counters, and nothing
+merged them back into one cluster view.  This module is the merge point:
+a dependency-free async HTTP scraper for the per-server introspection
+endpoint (:class:`~ratis_tpu.metrics.prometheus.MetricsHttpServer`) and a
+snapshot merger that folds every child's scrape into ONE cluster snapshot
+— per-process summaries keyed by pid plus cluster-wide totals.  The
+multi-process bench embeds the merged snapshot in its per-process
+decomposition report; ``python -m ratis_tpu.shell health`` pretty-prints
+the same scrapes for an operator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from typing import Optional
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def _split_address(address: str) -> tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def http_get(address: str, path: str, timeout_s: float = 10.0) -> bytes:
+    """Tiny HTTP/1.1 GET against the introspection endpoint (close-delim
+    bodies; the endpoint always sends Connection: close)."""
+    host, port = _split_address(address)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout_s)
+    try:
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                     f"Connection: close\r\n\r\n".encode())
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout_s)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status_line = head.split(b"\r\n", 1)[0]
+    status = status_line.split()
+    if len(status) < 2 or status[1] != b"200":
+        raise RuntimeError(f"GET {address}{path}: "
+                           f"{status_line.decode('latin-1', 'replace')}")
+    return body
+
+
+async def fetch_json(address: str, path: str,
+                     timeout_s: float = 10.0) -> object:
+    import json
+    return json.loads(await http_get(address, path, timeout_s))
+
+
+async def fetch_text(address: str, path: str,
+                     timeout_s: float = 10.0) -> str:
+    return (await http_get(address, path, timeout_s)).decode()
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """{'name{labels}': float} over every sample line (TYPE/HELP lines
+    skipped) — enough structure to merge counters across processes."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name = m.group(1) + (m.group(2) or "")
+        try:
+            out[name] = float(m.group(3))
+        except ValueError:
+            continue
+    return out
+
+
+async def scrape_server(address: str, timeout_s: float = 10.0) -> dict:
+    """One child's full introspection scrape: health + divisions + events
+    + parsed /metrics samples, plus the scrape address for re-scraping."""
+    health, divisions, events, metrics_text = await asyncio.gather(
+        fetch_json(address, "/health", timeout_s),
+        fetch_json(address, "/divisions", timeout_s),
+        fetch_json(address, "/events", timeout_s),
+        fetch_text(address, "/metrics", timeout_s))
+    return {
+        "address": address,
+        "health": health,
+        "divisions": divisions,
+        "events": events,
+        "metrics": parse_prometheus_text(metrics_text),
+    }
+
+
+def _summarize_proc(scrape: dict) -> dict:
+    """Compact per-process block of a merged snapshot (the full division
+    list and raw samples stay out of the bench artifact)."""
+    health = scrape.get("health", {})
+    divisions = scrape.get("divisions", [])
+    events = scrape.get("events", {})
+    roles: dict = {}
+    lag_max = 0
+    pending = 0
+    for d in divisions:
+        roles[d.get("role", "?")] = roles.get(d.get("role", "?"), 0) + 1
+        pending += d.get("pendingRequests", 0)
+        for f in (d.get("followers") or {}).values():
+            lag_max = max(lag_max, f.get("lag", 0))
+    metrics = scrape.get("metrics", {})
+
+    def g(name: str, default=0.0):
+        return metrics.get(name, default)
+
+    return {
+        "address": scrape.get("address"),
+        "peer": health.get("peer"),
+        "status": health.get("status"),
+        "divisions": len(divisions),
+        "roles": roles,
+        "pendingRequests": pending,
+        "followerLagMax": lag_max,
+        "engineTicks": (health.get("engine") or {}).get("ticks", 0),
+        "laneOccupancyGroups": g("ratis_engine_laneOccupancyGroups"),
+        "watchdogEvents": events.get("count", 0),
+        "eventKinds": sorted({e.get("kind")
+                              for e in events.get("events", [])}),
+    }
+
+
+def merge_cluster_snapshot(scrapes: list[dict]) -> dict:
+    """Fold per-child scrapes into one cluster snapshot: a per-pid
+    summary map + cluster totals (counter families summed across
+    processes, gauges left per-process)."""
+    procs: dict = {}
+    totals: dict = {}
+    events = 0
+    unhealthy = []
+    for scrape in scrapes:
+        health = scrape.get("health", {})
+        pid = str(health.get("pid", f"unknown-{len(procs)}"))
+        if pid in procs:
+            # co-hosted servers share a pid (in-process clusters): keep
+            # every server visible instead of last-writer-wins
+            pid = f"{pid}:{health.get('peer')}"
+        procs[pid] = _summarize_proc(scrape)
+        if procs[pid]["status"] != "ok":
+            unhealthy.append(procs[pid]["peer"])
+        events += procs[pid]["watchdogEvents"]
+        for name, value in scrape.get("metrics", {}).items():
+            if name.split("{", 1)[0].endswith("_total"):
+                totals[name.split("{", 1)[0]] = \
+                    totals.get(name.split("{", 1)[0], 0.0) + value
+    return {
+        "procs": procs,
+        "servers": len(scrapes),
+        "healthy": len(scrapes) - len(unhealthy),
+        "unhealthy_peers": unhealthy,
+        "watchdog_events": events,
+        "counter_totals": {k: totals[k] for k in sorted(totals)},
+    }
+
+
+async def scrape_cluster(addresses: list[str],
+                         timeout_s: float = 10.0) -> dict:
+    """Scrape every address concurrently and merge; a dead endpoint
+    becomes an ``unreachable`` proc entry instead of failing the merge
+    (the parent must report a half-dead cluster, not crash on it)."""
+    results = await asyncio.gather(
+        *(scrape_server(a, timeout_s) for a in addresses),
+        return_exceptions=True)
+    scrapes = []
+    unreachable = []
+    for address, res in zip(addresses, results):
+        if isinstance(res, BaseException):
+            # e.g. asyncio.TimeoutError stringifies empty: keep the type
+            unreachable.append({"address": address,
+                                "error": str(res) or type(res).__name__})
+        else:
+            scrapes.append(res)
+    merged = merge_cluster_snapshot(scrapes)
+    if unreachable:
+        merged["unreachable"] = unreachable
+    return merged

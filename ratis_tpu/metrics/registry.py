@@ -65,6 +65,16 @@ class Counter:
         return self._value
 
 
+def labeled(name: str, **labels: str) -> str:
+    """Canonical registry name for a labeled metric: ``name{k="v",...}``
+    with keys sorted.  The Prometheus renderer splits this form back into
+    base name + label set and merges the registry's ``member`` label in."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
 class Timekeeper:
     """Timer with count/total and a bounded reservoir for percentiles
     (reference Timekeeper + dropwizard Timer)."""
@@ -135,14 +145,26 @@ class Timekeeper:
                 "p99_s": self.percentile_s(0.99)}
 
 
+class Histogram(Timekeeper):
+    """Value histogram over the same bounded reservoir: batch sizes, queue
+    depths — dimensionless quantities, not durations (the snapshot keys
+    carry no ``_s`` suffix and the Prometheus renderer emits no unit)."""
+
+    def snapshot(self) -> dict:
+        return {"count": self._count, "mean": self.mean_s,
+                "max": self._max_s, "p50": self.percentile_s(0.50),
+                "p99": self.percentile_s(0.99)}
+
+
 class RatisMetricRegistry:
-    """One named registry of counters/gauges/timers
+    """One named registry of counters/gauges/timers/histograms
     (RatisMetricRegistry.java / impl/RatisMetricRegistryImpl.java)."""
 
     def __init__(self, info: MetricRegistryInfo) -> None:
         self.info = info
         self._counters: Dict[str, Counter] = {}
         self._timers: Dict[str, Timekeeper] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._gauges: Dict[str, Callable[[], object]] = {}
         self._lock = threading.Lock()
 
@@ -154,6 +176,10 @@ class RatisMetricRegistry:
         with self._lock:
             return self._timers.setdefault(name, Timekeeper())
 
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram())
+
     def gauge(self, name: str, supplier: Callable[[], object]) -> None:
         with self._lock:
             self._gauges[name] = supplier
@@ -162,28 +188,40 @@ class RatisMetricRegistry:
         with self._lock:
             return (self._counters.pop(name, None) is not None
                     or self._timers.pop(name, None) is not None
+                    or self._histograms.pop(name, None) is not None
                     or self._gauges.pop(name, None) is not None)
 
     def metric_names(self) -> list[str]:
         with self._lock:
-            return sorted([*self._counters, *self._timers, *self._gauges])
+            return sorted([*self._counters, *self._timers,
+                           *self._histograms, *self._gauges])
 
     def snapshot(self) -> dict:
         """Flat {metric: value} view (console/JMX reporter analog)."""
+        return {name: value for name, (_kind, value)
+                in self.typed_snapshot().items()}
+
+    def typed_snapshot(self) -> dict:
+        """{metric: (kind, value)} where kind is one of counter/timer/
+        histogram/gauge — the Prometheus renderer needs the kind (counters
+        get the ``_total`` suffix, histogram quantiles carry no unit)."""
         out: dict = {}
         with self._lock:
             counters = dict(self._counters)
             timers = dict(self._timers)
+            histograms = dict(self._histograms)
             gauges = dict(self._gauges)
         for name, c in counters.items():
-            out[name] = c.count
+            out[name] = ("counter", c.count)
         for name, t in timers.items():
-            out[name] = t.snapshot()
+            out[name] = ("timer", t.snapshot())
+        for name, h in histograms.items():
+            out[name] = ("histogram", h.snapshot())
         for name, g in gauges.items():
             try:
-                out[name] = g()
+                out[name] = ("gauge", g())
             except Exception as e:  # gauge suppliers must never break reports
-                out[name] = f"<error: {e}>"
+                out[name] = ("gauge", f"<error: {e}>")
         return out
 
 

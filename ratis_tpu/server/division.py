@@ -264,6 +264,45 @@ class Division:
             return None
         return self.state.configuration.get_peer(lid)
 
+    def introspect(self) -> dict:
+        """Structured per-division introspection (the ``/divisions``
+        endpoint and the stall watchdog both read this): role, term,
+        commit/applied frontier, per-follower replication lag, cache and
+        queue sizes, and loop-shard placement.  Pure reads over state the
+        division already maintains — safe from the endpoint's connection
+        handler on any loop, never awaits."""
+        log = self.state.log
+        commit = int(log.get_last_committed_index())
+        out = {
+            "group": str(self.group_id),
+            "role": self.role.name,
+            "term": int(self.state.current_term),
+            "leader": (str(self.state.leader_id)
+                       if self.state.leader_id is not None else None),
+            "commitIndex": commit,
+            "lastApplied": int(self._applied_index),
+            "flushIndex": int(log.flush_index),
+            "retryCacheSize": len(self.retry_cache),
+            "pendingRequests": (len(self.leader_ctx.pending)
+                                if self.leader_ctx is not None else 0),
+            "hibernating": bool(self._hibernating),
+            "loopShard": self.server.shard_of_group(self.group_id),
+            "shardQueueDepth":
+                self.server.shard_queue_depth(self.group_id),
+        }
+        if self.leader_ctx is not None:
+            now = time.monotonic()
+            out["followers"] = {
+                str(pid): {
+                    "matchIndex": int(f.match_index),
+                    "nextIndex": int(f.next_index),
+                    "lag": max(0, commit - int(f.match_index)),
+                    "lastRpcElapsedS": round(
+                        now - f.last_rpc_response_s, 3),
+                }
+                for pid, f in list(self.leader_ctx.followers.items())}
+        return out
+
     # -------------------------------------------------------- engine wiring
 
     def attach_engine(self) -> None:

@@ -46,6 +46,20 @@ class PauseMonitor:
         self.pause_count = 0
         self.stepdown_count = 0
         self.max_pause_s = 0.0
+        # detections in the server registry, not just the log (reference
+        # JvmPauseMonitor publishes the same pair through its metrics):
+        # numPauses counter + longestPauseMs gauge, scraped at
+        # ratis_server_numPauses_total / ratis_server_longestPauseMs.
+        from ratis_tpu.metrics.registry import (MetricRegistries,
+                                                MetricRegistryInfo)
+        info = MetricRegistryInfo(prefix=str(server.peer_id),
+                                  application="ratis", component="server",
+                                  name="pause_monitor")
+        self.registry = MetricRegistries.global_registries().create(info)
+        self.num_pauses = self.registry.counter("numPauses")
+        self.num_stepdowns = self.registry.counter("numStepDowns")
+        self.registry.gauge("longestPauseMs",
+                            lambda: round(self.max_pause_s * 1e3, 3))
 
     def start(self) -> None:
         self._running = True
@@ -61,6 +75,8 @@ class PauseMonitor:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        from ratis_tpu.metrics.registry import MetricRegistries
+        MetricRegistries.global_registries().remove(self.registry.info)
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
@@ -71,6 +87,7 @@ class PauseMonitor:
             if pause <= self.warn_s:
                 continue
             self.pause_count += 1
+            self.num_pauses.inc()
             self.max_pause_s = max(self.max_pause_s, pause)
             LOG.warning("%s: event loop paused ~%.0fms (threshold %.0fms)",
                         self.server.peer_id, pause * 1e3, self.warn_s * 1e3)
@@ -81,6 +98,7 @@ class PauseMonitor:
         for div in list(self.server.divisions.values()):
             if div.is_leader():
                 self.stepdown_count += 1
+                self.num_stepdowns.inc()
                 await div.change_to_follower(
                     div.state.current_term, None,
                     reason=f"event loop paused {pause * 1e3:.0f}ms, beyond "

@@ -272,8 +272,15 @@ class RaftServer:
             leadership_timeout_ms=int(
                 RaftServerConfigKeys.Rpc.timeout_max(p).to_ms() * 2),
             mesh=mesh,
-            profile_dir=RaftServerConfigKeys.Engine.profile_dir(p) or None)
+            profile_dir=RaftServerConfigKeys.Engine.profile_dir(p) or None,
+            name=str(peer_id))
         self.pause_monitor = None  # started in start() when enabled
+        # Observability plane (raft.tpu.metrics.http-port /
+        # raft.tpu.watchdog.*): the per-server introspection endpoint and
+        # the stall watchdog, both created in start().  With the port key
+        # unset no listener socket is ever opened.
+        self.metrics_http = None
+        self.watchdog = None
         from ratis_tpu.conf.reconfiguration import ReconfigurationManager
         # live property reconfiguration (divisions register their knobs)
         self.reconfiguration = ReconfigurationManager(properties)
@@ -361,6 +368,19 @@ class RaftServer:
             from ratis_tpu.server.pause_monitor import PauseMonitor
             self.pause_monitor = PauseMonitor(self)
             self.pause_monitor.start()
+        if _K.Watchdog.enabled(self.properties):
+            from ratis_tpu.server.watchdog import StallWatchdog
+            self.watchdog = StallWatchdog(self)
+            self.watchdog.start()
+        http_port = _K.Metrics.http_port(self.properties)
+        if http_port is not None:
+            from ratis_tpu.metrics.prometheus import MetricsHttpServer
+            self.metrics_http = MetricsHttpServer(
+                port=http_port,
+                json_routes={"/health": self.health_info,
+                             "/divisions": self.divisions_info,
+                             "/events": self.watchdog_events})
+            await self.metrics_http.start()
         if self.shards is None:
             self.heartbeat_scheduler.start()
         else:
@@ -407,6 +427,12 @@ class RaftServer:
             if not self.life_cycle.compare_and_transition(
                     LifeCycleState.NEW, LifeCycleState.CLOSING):
                 return
+        if self.metrics_http is not None:
+            await self.metrics_http.close()
+            self.metrics_http = None
+        if self.watchdog is not None:
+            await self.watchdog.close()
+            self.watchdog = None
         if self.pause_monitor is not None:
             await self.pause_monitor.close()
             self.pause_monitor = None
@@ -608,6 +634,68 @@ class RaftServer:
         if self.shards is None:
             return 0
         return self.shards.shard_of(group_id.to_bytes())
+
+    def shard_queue_depth(self, group_id: RaftGroupId) -> int:
+        """Ready-callback backlog of the loop owning ``group_id``'s
+        division (-1 unknown) — the queueing signal the /divisions
+        endpoint surfaces per division."""
+        from ratis_tpu.server.shards import loop_ready_depth
+        if self.shards is not None:
+            return self.shards.queue_depth(self.shard_of_group(group_id))
+        try:
+            return loop_ready_depth(asyncio.get_running_loop())
+        except RuntimeError:
+            return -1
+
+    # -------------------------------------------- observability endpoints
+
+    def health_info(self) -> dict:
+        """GET /health: liveness + engine tick freshness.  The engine tick
+        is the server's heartbeat-of-heartbeats — a stale tick means every
+        hosted group's election/commit math is stalled."""
+        import os
+        import time as _time
+        last = self.engine.last_tick_monotonic
+        age = (None if last is None
+               else round(_time.monotonic() - last, 3))
+        # fresh = the tick loop ran within a generous multiple of its
+        # cadence (the loop sleeps at most tick_interval between passes;
+        # 50x tolerates load, a floor of 2s tolerates tiny intervals)
+        fresh_bound = max(2.0, 50 * self.engine.tick_interval_s)
+        state = self.life_cycle.get_current_state().name
+        ok = (state == "RUNNING" and age is not None and age < fresh_bound)
+        return {
+            "status": "ok" if ok else "degraded",
+            "peer": str(self.peer_id),
+            "address": self.address,
+            "pid": os.getpid(),
+            "lifecycle": state,
+            "divisions": len(self.divisions),
+            "loopShards": self.loop_shards,
+            "engine": {
+                "ticks": self.engine.metrics["ticks"],
+                "lastTickAgeS": age,
+                "freshBoundS": fresh_bound,
+                "groupsLive": len(self.engine.state.active),
+                "groupsCapacity": self.engine.state.capacity,
+            },
+            "watchdogEvents": (self.watchdog.event_count()
+                               if self.watchdog is not None else 0),
+        }
+
+    def divisions_info(self) -> list:
+        """GET /divisions: per-division introspection (role, term,
+        commit/applied, follower lag, cache sizes, shard placement)."""
+        return [div.introspect()
+                for div in list(self.divisions.values())]
+
+    def watchdog_events(self) -> dict:
+        """GET /events: the stall watchdog's bounded event journal."""
+        if self.watchdog is None:
+            return {"enabled": False, "events": []}
+        return {"enabled": True,
+                "count": self.watchdog.event_count(),
+                "events": self.watchdog.events()}
 
     async def _run_on_division_loop(self, group_id: RaftGroupId, coro):
         """Await ``coro`` on the loop owning ``group_id``'s division; a

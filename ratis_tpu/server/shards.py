@@ -30,6 +30,23 @@ from typing import Optional
 LOG = logging.getLogger(__name__)
 
 
+def loop_ready_depth(loop: Optional[asyncio.AbstractEventLoop]) -> int:
+    """Best-effort ready-callback backlog of ``loop`` — the queueing the
+    traced decomposition blamed for the north-star residual, now a live
+    introspection signal (/divisions shardQueueDepth).  CPython's event
+    loop keeps its ready queue in ``_ready``; a loop implementation
+    without one reports -1 (unknown), never raises."""
+    if loop is None:
+        return -1
+    ready = getattr(loop, "_ready", None)
+    if ready is None:
+        return -1
+    try:
+        return len(ready)
+    except Exception:
+        return -1
+
+
 class LoopShardPool:
     """N event loops; shard 0 is the caller's (primary) loop, the rest run
     ``run_forever`` on daemon threads until :meth:`close`."""
@@ -85,6 +102,15 @@ class LoopShardPool:
 
     def loop(self, idx: int) -> asyncio.AbstractEventLoop:
         return self._loops[idx]
+
+    def queue_depth(self, idx: int) -> int:
+        """Ready-callback backlog of shard ``idx``'s loop (-1 unknown)."""
+        if not self.started or idx >= len(self._loops):
+            return -1
+        return loop_ready_depth(self._loops[idx])
+
+    def queue_depths(self) -> list[int]:
+        return [self.queue_depth(i) for i in range(self.n)]
 
     def loop_index(self, loop: Optional[asyncio.AbstractEventLoop] = None
                    ) -> int:

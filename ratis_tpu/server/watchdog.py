@@ -1,0 +1,203 @@
+"""Stall watchdog: always-on derived health signals per server.
+
+No reference analog — the reference leaves "is the cluster making
+progress?" to external alerting over its metrics; the multi-raft host
+here can answer it locally, cheaply, from state it already maintains.  A
+single per-server sampling task (``raft.tpu.watchdog.*``) walks the
+division fleet every interval and journals structured events for three
+failure shapes the perf rounds have actually hit:
+
+- **commit-stall**: a leader's commitIndex is flat across consecutive
+  samples while client requests are pending — the shape of a lost quorum
+  (isolated leader, dead followers) or a wedged replication path.
+- **election-churn**: server-wide election activity (timeouts fired +
+  elections started) above a rate threshold — the storm signature that
+  deposed thousands of leaders in rounds 4-5.
+- **follower-lag**: a follower's match index more than a threshold of
+  entries behind its leader's commit — a snapshot-install candidate or a
+  silently failing appender.
+
+Events land in a bounded ring journal (never unbounded memory, oldest
+drop first) served at ``GET /events`` by the metrics endpoint and
+pretty-printed by ``python -m ratis_tpu.shell health``.  Detection
+counters live in a real registry ("server" component, name "watchdog")
+so the scrape carries them too.  The watchdog only READS division state
+— it never awaits into division code and adds nothing to the request
+path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+from typing import Optional
+
+from ratis_tpu.metrics.registry import (MetricRegistries, MetricRegistryInfo,
+                                        labeled)
+
+LOG = logging.getLogger(__name__)
+
+KIND_COMMIT_STALL = "commit-stall"
+KIND_ELECTION_CHURN = "election-churn"
+KIND_FOLLOWER_LAG = "follower-lag"
+KINDS = (KIND_COMMIT_STALL, KIND_ELECTION_CHURN, KIND_FOLLOWER_LAG)
+
+# consecutive flat samples (with pending requests) before a commit-stall
+# event is journaled: one flat interval is ordinary queueing, two is not
+_STALL_ROUNDS = 2
+
+
+class StallWatchdog:
+    def __init__(self, server, interval_s: Optional[float] = None,
+                 journal_size: Optional[int] = None,
+                 lag_threshold: Optional[int] = None,
+                 churn_threshold: Optional[int] = None):
+        from ratis_tpu.conf.keys import RaftServerConfigKeys
+        keys = RaftServerConfigKeys.Watchdog
+        p = server.properties
+        self.server = server
+        self.interval_s = (interval_s if interval_s is not None
+                           else keys.interval(p).seconds)
+        self.lag_threshold = (lag_threshold if lag_threshold is not None
+                              else keys.follower_lag_threshold(p))
+        self.churn_threshold = (churn_threshold
+                                if churn_threshold is not None
+                                else keys.churn_threshold(p))
+        size = (journal_size if journal_size is not None
+                else keys.journal_size(p))
+        self.journal: collections.deque = collections.deque(
+            maxlen=max(1, size))
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+        # group -> (last commitIndex, consecutive flat-with-pending rounds)
+        self._stall: dict = {}
+        # groups currently inside a reported stall / lag episode: one event
+        # per episode, not one per sample
+        self._stalled: set = set()
+        self._lagging: set = set()
+        self._last_elections = None  # server-wide election activity count
+        info = MetricRegistryInfo(prefix=str(server.peer_id),
+                                  application="ratis", component="server",
+                                  name="watchdog")
+        self.registry = MetricRegistries.global_registries().create(info)
+        self.event_counters = {
+            kind: self.registry.counter(labeled("events", kind=kind))
+            for kind in KINDS}
+        self.registry.gauge("journalSize", lambda: len(self.journal))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._running = True
+        self._task = asyncio.create_task(
+            self._run(), name=f"watchdog-{self.server.peer_id}")
+
+    async def close(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        MetricRegistries.global_registries().remove(self.registry.info)
+
+    # -------------------------------------------------------------- journal
+
+    def emit(self, kind: str, group: Optional[str], detail: str) -> None:
+        self.journal.append({
+            "t": round(time.time(), 3),
+            "kind": kind,
+            "group": group,
+            "detail": detail,
+        })
+        c = self.event_counters.get(kind)
+        if c is not None:
+            c.inc()
+        LOG.warning("%s watchdog: %s%s: %s", self.server.peer_id, kind,
+                    f" [{group}]" if group else "", detail)
+
+    def events(self) -> list[dict]:
+        """Journal contents, oldest first (the /events payload)."""
+        return list(self.journal)
+
+    def event_count(self) -> int:
+        return sum(c.count for c in self.event_counters.values())
+
+    # ------------------------------------------------------------- sampling
+
+    async def _run(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.interval_s)
+            try:
+                self.sample()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the watchdog must never take the server down with it
+                LOG.exception("%s watchdog sample failed",
+                              self.server.peer_id)
+
+    def sample(self) -> None:
+        """One detection pass over the division fleet (synchronous reads
+        only).  Public so tests and harnesses can force a pass."""
+        elections = 0
+        seen = set()
+        for div in list(self.server.divisions.values()):
+            gid = str(div.group_id)
+            seen.add(gid)
+            em = div.election_metrics
+            elections += em.timeout_count.count + em.election_count.count
+            if not div.is_leader() or div.leader_ctx is None:
+                self._stall.pop(gid, None)
+                self._stalled.discard(gid)
+                continue
+            commit = int(div.state.log.get_last_committed_index())
+            pending = len(div.leader_ctx.pending)
+            last_commit, rounds = self._stall.get(gid, (None, 0))
+            if pending > 0 and commit == last_commit:
+                rounds += 1
+            else:
+                rounds = 0
+                self._stalled.discard(gid)
+            self._stall[gid] = (commit, rounds)
+            if rounds >= _STALL_ROUNDS and gid not in self._stalled:
+                self._stalled.add(gid)
+                self.emit(KIND_COMMIT_STALL, gid,
+                          f"commitIndex flat at {commit} for "
+                          f"{rounds * self.interval_s:.1f}s with "
+                          f"{pending} pending request(s)")
+            # follower lag (leader view): one event per lag episode
+            worst = None
+            for pid, f in list(div.leader_ctx.followers.items()):
+                lag = commit - int(f.match_index)
+                if lag > self.lag_threshold and (
+                        worst is None or lag > worst[1]):
+                    worst = (pid, lag)
+            if worst is not None:
+                if gid not in self._lagging:
+                    self._lagging.add(gid)
+                    self.emit(KIND_FOLLOWER_LAG, gid,
+                              f"follower {worst[0]} is {worst[1]} entries "
+                              f"behind commit {commit} "
+                              f"(threshold {self.lag_threshold})")
+            else:
+                self._lagging.discard(gid)
+        # drop bookkeeping for removed groups
+        for gid in list(self._stall):
+            if gid not in seen:
+                self._stall.pop(gid, None)
+        self._stalled &= seen
+        self._lagging &= seen
+        # election churn: rate of new election activity per interval
+        if self._last_elections is not None:
+            delta = elections - self._last_elections
+            if delta >= self.churn_threshold:
+                self.emit(KIND_ELECTION_CHURN, None,
+                          f"{delta} election timeouts/starts in "
+                          f"{self.interval_s:.1f}s "
+                          f"(threshold {self.churn_threshold})")
+        self._last_elections = elections

@@ -212,6 +212,71 @@ async def cmd_snapshot_create(args) -> int:
     return 1
 
 
+async def cmd_health(args) -> int:
+    """Cluster health from the observability plane: scrape one or more
+    servers' introspection endpoints (``raft.tpu.metrics.http-port``) and
+    pretty-print liveness, engine freshness, per-division state, and the
+    stall watchdog's journal.  Exit 0 = every endpoint reachable and ok;
+    1 = any endpoint degraded, unreachable, or with journaled events."""
+    from ratis_tpu.metrics.aggregate import scrape_cluster
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints:
+        raise SystemExit("pass -endpoints host:port[,host:port...]")
+    merged = await scrape_cluster(endpoints, timeout_s=args.timeout)
+    rc = 0
+    procs = merged.get("procs", {})
+    print(f"cluster: {merged['healthy']}/{merged['servers']} server(s) "
+          f"healthy, {merged['watchdog_events']} watchdog event(s)")
+    for pid, proc in sorted(procs.items()):
+        roles = ", ".join(f"{n} {r}" for r, n in
+                          sorted(proc.get("roles", {}).items()))
+        print(f"  {proc.get('peer')} pid={pid} @{proc.get('address')}: "
+              f"{proc.get('status')} | {proc.get('divisions')} division(s)"
+              f"{' (' + roles + ')' if roles else ''} | "
+              f"engine ticks={proc.get('engineTicks')} "
+              f"occupancy={proc.get('laneOccupancyGroups'):.3f} | "
+              f"pending={proc.get('pendingRequests')} "
+              f"lagMax={proc.get('followerLagMax')}")
+        if proc.get("status") != "ok":
+            rc = 1
+    for dead in merged.get("unreachable", []):
+        print(f"  UNREACHABLE {dead['address']}: {dead['error']}")
+        rc = 1
+    if args.verbose:
+        for address in endpoints:
+            from ratis_tpu.metrics.aggregate import fetch_json
+            try:
+                divisions = await fetch_json(address, "/divisions",
+                                             args.timeout)
+            except Exception:
+                continue
+            print(f"  divisions @{address}:")
+            for d in divisions:
+                fol = " ".join(
+                    f"{p}:lag={f['lag']}"
+                    for p, f in sorted((d.get("followers") or {}).items()))
+                print(f"    {d['group']} {d['role'].lower()} "
+                      f"term={d['term']} commit={d['commitIndex']} "
+                      f"applied={d['lastApplied']} "
+                      f"shard={d['loopShard']}"
+                      f"{' | ' + fol if fol else ''}")
+    shown = 0
+    for address in endpoints:
+        from ratis_tpu.metrics.aggregate import fetch_json
+        try:
+            events = await fetch_json(address, "/events", args.timeout)
+        except Exception:
+            continue
+        for e in events.get("events", []):
+            if shown == 0:
+                print("watchdog events:")
+            shown += 1
+            rc = 1
+            group = f" [{e['group']}]" if e.get("group") else ""
+            print(f"  {address} {e['kind']}{group}: {e['detail']}")
+    return rc
+
+
 def cmd_local_raft_meta_conf(args) -> int:
     """Offline rewrite of raft-meta.conf to a new peer list (reference
     `local raftMetaConf`, used to resurrect a group whose quorum is gone)."""
@@ -306,6 +371,17 @@ def build_parser() -> argparse.ArgumentParser:
     _add_target(p)
     p.add_argument("-creationGap", type=int, default=0)
     p.set_defaults(func=cmd_snapshot_create)
+
+    p = sub.add_parser(
+        "health",
+        help="scrape servers' observability endpoints "
+             "(raft.tpu.metrics.http-port) and print cluster health")
+    p.add_argument("-endpoints", required=True,
+                   help="comma list of host:port metrics endpoints")
+    p.add_argument("-timeout", type=float, default=10.0, help="seconds")
+    p.add_argument("-verbose", action="store_true",
+                   help="also print every division's state")
+    p.set_defaults(func=cmd_health)
 
     lo = sub.add_parser("local").add_subparsers(dest="sub", required=True)
     p = lo.add_parser("raftMetaConf")

@@ -669,6 +669,12 @@ def _mp_server_main() -> None:
             transport=transport, trace=spec.get("trace", False),
             trace_sample=spec.get("trace_sample", 32),
             loop_shards=spec.get("loop_shards", 1))
+        # Observability plane: every measurement child serves the
+        # introspection endpoint on an ephemeral port and reports the
+        # bound port on the MPSTARTED handshake line so the parent can
+        # scrape and merge the per-process registries at rung end.
+        properties.set("raft.tpu.metrics.http-port",
+                       str(spec.get("metrics_port", 0)))
         me = peers[spec["peer_index"]]
         sm_factory = _mp_sm_factory(spec.get("sm", "counter"))
         if batched:
@@ -693,7 +699,11 @@ def _mp_server_main() -> None:
         # there.  Without the barrier, the slowest child's jax import
         # lands inside its siblings' election timeouts and fresh
         # followers self-elect against the not-yet-sent appointments.
-        print("MPSTARTED", flush=True)
+        # The suffix is this child's metrics scrape port (0 = endpoint
+        # off), riding the existing phased bring-up pipe.
+        mport = (server.metrics_http.bound_port
+                 if server.metrics_http is not None else 0)
+        print(f"MPSTARTED {mport}", flush=True)
 
         loop = asyncio.get_running_loop()
         while True:
@@ -757,12 +767,30 @@ def _mp_server_main() -> None:
                 from ratis_tpu.trace import get_tracer
                 get_tracer().reset()
                 print("MPTRACED", flush=True)
+            elif cmd.startswith("TRACEDUMP "):
+                # write this process's Chrome trace so the parent can
+                # concatenate every child's into one cluster trace
+                from ratis_tpu.trace import get_tracer
+                from ratis_tpu.trace.export import write_chrome_trace
+                try:
+                    write_chrome_trace(cmd[len("TRACEDUMP "):],
+                                       get_tracer().snapshot())
+                except OSError as e:
+                    print(f"mp-server: trace dump failed: {e}",
+                          file=sys.stderr, flush=True)
+                print("MPTRACEDUMPED", flush=True)
             elif cmd == "REPORT":
                 report: dict = {
                     "pid": os.getpid(),
                     "engine": {k: server.engine.metrics.get(k, 0)
                                for k in ("ticks", "batched_dispatches",
                                          "commit_advances")},
+                    "engine_occupancy": round(
+                        len(server.engine.state.active)
+                        / server.engine.state.capacity, 4),
+                    "watchdog_events": (
+                        server.watchdog.event_count()
+                        if server.watchdog is not None else 0),
                     "append_rewinds":
                         server.replication.metrics.get("rewinds", 0),
                 }
@@ -934,11 +962,17 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
                               sm: str = "counter",
                               trace: bool = False,
                               trace_sample: int = 32,
+                              trace_out: Optional[str] = None,
                               bringup_timeout_s: float = 900.0,
                               load_timeout_s: float = 1200.0) -> dict:
     """The cluster as N server processes + M client processes over real
     sockets; returns the same result-dict shape as :func:`run_bench` plus
-    an ``mp`` block."""
+    an ``mp`` block and a ``cluster_metrics`` block (every child's
+    introspection endpoint scraped at rung end and merged into one
+    snapshot — metrics/aggregate.py).  With ``trace`` on and
+    ``trace_out`` set, each server child dumps its Perfetto export and
+    the parent concatenates them into one merged chrome-trace keyed by
+    pid at ``trace_out``."""
     import json
     import os
 
@@ -975,9 +1009,12 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
                 "batched": batched, "transport": transport, "sm": sm,
                 "loop_shards": loop_shards, "trace": trace,
                 "trace_sample": trace_sample}))
+        scrape_ports: list[int] = []
         for i, proc in enumerate(servers):
-            await _mp_wait_line(proc, "MPSTARTED", bringup_timeout_s,
-                                f"server{i}")
+            started = await _mp_wait_line(proc, "MPSTARTED",
+                                          bringup_timeout_s, f"server{i}")
+            parts = started.split()
+            scrape_ports.append(int(parts[1]) if len(parts) > 1 else 0)
         for proc in servers:
             proc.stdin.write(b"ADDGROUPS\n")
             await proc.stdin.drain()
@@ -1015,6 +1052,41 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
                                        f"client{i}")
             outs.append(json.loads(line[len("MPRESULT "):]))
 
+        # Rung-end cluster scrape: merge every child's registries/health/
+        # events into ONE snapshot while the servers are still alive.
+        cluster_metrics = None
+        addresses = [f"127.0.0.1:{port}" for port in scrape_ports if port]
+        if addresses:
+            from ratis_tpu.metrics.aggregate import scrape_cluster
+            try:
+                cluster_metrics = await scrape_cluster(addresses)
+            except Exception as e:
+                print(f"bench: cluster scrape failed: {e}",
+                      file=sys.stderr, flush=True)
+
+        # Merged Perfetto artifact: each server child dumps its chrome
+        # trace, the parent concatenates them keyed by pid.
+        merged_trace_pids = 0
+        if trace and trace_out:
+            import tempfile
+            tdir = tempfile.mkdtemp(prefix="ratis-mp-trace-")
+            paths = []
+            for i, proc in enumerate(servers):
+                path = os.path.join(tdir, f"trace_s{i}.json")
+                proc.stdin.write(f"TRACEDUMP {path}\n".encode())
+                await proc.stdin.drain()
+                try:
+                    await _mp_wait_line(proc, "MPTRACEDUMPED", 120.0,
+                                        f"server{i}")
+                    paths.append(path)
+                except (TimeoutError, RuntimeError) as e:
+                    print(f"bench: server{i} trace dump unavailable: {e}",
+                          file=sys.stderr, flush=True)
+            from ratis_tpu.trace.export import merge_chrome_trace_files
+            merged = merge_chrome_trace_files(paths, trace_out)
+            merged_trace_pids = len({e.get("pid")
+                                     for e in merged["traceEvents"]})
+
         total = num_groups * writes_per_group
         commits = sum(o["commits"] for o in outs)
         failures = sum(o["failures"] for o in outs)
@@ -1045,6 +1117,13 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
                    "client_procs": len(parts),
                    "loop_shards": loop_shards},
         }
+        if cluster_metrics is not None:
+            result["cluster_metrics"] = cluster_metrics
+            result["watchdog_events"] = cluster_metrics.get(
+                "watchdog_events", 0)
+        if trace and trace_out:
+            result["trace_out"] = os.path.abspath(trace_out)
+            result["trace_pids"] = merged_trace_pids
         servers[0].stdin.write(b"REPORT\n")
         await servers[0].stdin.drain()
         try:
@@ -1052,6 +1131,7 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
                                       "server0")
             report = json.loads(rep[len("MPREPORT "):])
             result["append_rewinds"] = report.get("append_rewinds", 0)
+            result["engine_occupancy"] = report.get("engine_occupancy")
             if trace and "host_path_decomposition" in report:
                 result["host_path_decomposition"] = \
                     report["host_path_decomposition"]
@@ -1206,6 +1286,15 @@ async def run_bench(num_groups: int, writes_per_group: int,
             v = sum(e.metrics.get(reason, 0) for e in engines)
             if v:
                 result[reason] = v
+        # flagship observability signals: group-lane occupancy (live rows
+        # vs padded [G, P] capacity — the "are we actually batching"
+        # number) and the stall watchdog's event count over the rung
+        result["engine_occupancy"] = round(
+            sum(len(e.state.active) for e in engines)
+            / max(1, sum(e.state.capacity for e in engines)), 4)
+        result["watchdog_events"] = sum(
+            s2.watchdog.event_count() for s2 in cluster.servers
+            if s2.watchdog is not None)
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport

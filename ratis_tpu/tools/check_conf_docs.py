@@ -1,0 +1,128 @@
+"""Conf-key / documentation drift check.
+
+Every configuration key the code defines (``*_KEY = "raft..."`` constants
+in ``ratis_tpu/conf/keys.py``) must appear in ``docs/configurations.md``,
+and every key the doc names must exist in the code — PRs 2-3 each added
+key families and the doc silently fell behind.  Run directly::
+
+    python -m ratis_tpu.tools.check_conf_docs
+
+or through the tier-1 test ``tests/test_conf_docs.py``.
+
+Doc key grammar (inside backticks, in tables or prose):
+
+- a full dotted key: ``raft.server.rpc.timeout.min``
+- suffix alternation on ONE line: ``raft.x.y.min/.max`` or a later
+  bare ``.suffix`` token — the suffix replaces the previous key's last
+  segment (multi-segment suffixes replace one segment, so
+  ``raft.a.b.enabled/.warn.threshold`` yields ``raft.a.b.warn.threshold``);
+- a family wildcard: ``raft.grpc.tls.*`` — matches every code key under
+  that prefix (and must match at least one, or the wildcard itself is
+  drift).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+KEYS_PY = os.path.join(_REPO, "ratis_tpu", "conf", "keys.py")
+DOCS_MD = os.path.join(_REPO, "docs", "configurations.md")
+
+_CODE_KEY_RE = re.compile(
+    r'^\s*[A-Z0-9_]+_KEY\s*=\s*(?:\\\s*)?$|'
+    r'_KEY\s*=\s*"(raft[a-z0-9_.\-]+)"')
+# a _KEY assignment whose string literal wrapped to the next line
+_CONT_STR_RE = re.compile(r'^\s*"(raft[a-z0-9_.\-]+)"')
+_DOC_TOKEN_RE = re.compile(r"`([a-z0-9_.\-*/]+)`|"
+                           r"(?<![`\w.])(raft\.[a-z0-9_.\-]+[a-z0-9_\-])")
+
+
+def code_keys(path: str = KEYS_PY) -> set[str]:
+    """Every dotted key string assigned to a ``*_KEY`` constant."""
+    keys: set[str] = set()
+    pending = False  # previous line was `X_KEY = \` (wrapped literal)
+    for line in open(path):
+        if pending:
+            m = _CONT_STR_RE.match(line)
+            if m:
+                keys.add(m.group(1))
+            pending = False
+            continue
+        m = re.search(r'_KEY\s*=\s*"(raft[a-z0-9_.\-]+)"', line)
+        if m:
+            keys.add(m.group(1))
+        elif re.search(r'_KEY\s*=\s*\\\s*$', line):
+            pending = True
+    return keys
+
+
+def doc_keys(path: str = DOCS_MD) -> tuple[set[str], set[str]]:
+    """(exact keys, wildcard prefixes) named by the doc."""
+    exact: set[str] = set()
+    wildcards: set[str] = set()
+    for line in open(path):
+        if line.lstrip().startswith("#"):
+            # section headings name namespaces (`raft.server.*`) for
+            # orientation; only table/prose wildcards COVER keys
+            continue
+        last: str | None = None
+        for m in _DOC_TOKEN_RE.finditer(line):
+            token = m.group(1) or m.group(2)
+            for part in token.split("/"):
+                if not part:
+                    continue
+                if part.startswith("raft."):
+                    if part.endswith(".*"):
+                        wildcards.add(part[:-2])
+                    else:
+                        exact.add(part)
+                        last = part
+                elif part.startswith(".") and last is not None:
+                    # suffix alternation: replace the previous key's last
+                    # segment with this (possibly multi-segment) suffix
+                    base = last.rsplit(".", 1)[0]
+                    key = base + part
+                    exact.add(key)
+                    last = key
+    return exact, wildcards
+
+
+def check() -> list[str]:
+    """Drift findings; empty = code and docs agree."""
+    code = code_keys()
+    exact, wildcards = doc_keys()
+    problems: list[str] = []
+    for key in sorted(code):
+        if key in exact:
+            continue
+        if any(key.startswith(w + ".") for w in wildcards):
+            continue
+        problems.append(f"key not documented in docs/configurations.md: "
+                        f"{key}")
+    for key in sorted(exact):
+        if key not in code:
+            problems.append(f"documented key missing from conf/keys.py: "
+                            f"{key}")
+    for w in sorted(wildcards):
+        if not any(key.startswith(w + ".") for key in code):
+            problems.append(f"documented wildcard matches no key: {w}.*")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} conf/doc drift problem(s)", file=sys.stderr)
+        return 1
+    print(f"ok: {len(code_keys())} keys in sync with docs/configurations.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,3 +118,36 @@ def write_chrome_trace(path: str, records) -> str:
     with open(path, "w") as f:
         json.dump(to_chrome_trace(records), f)
     return path
+
+
+def merge_chrome_traces(traces: "list[dict]") -> dict:
+    """Fold per-process Chrome trace exports into ONE cluster trace.
+
+    Every event already carries the recording process id (``pid``), so a
+    merge is a concatenation: Perfetto renders one process group per pid
+    with that process's per-request tracks inside it.  Malformed inputs
+    (a child that crashed mid-write) contribute nothing rather than
+    poisoning the merged artifact."""
+    events: list = []
+    for trace in traces:
+        if isinstance(trace, dict):
+            evs = trace.get("traceEvents")
+            if isinstance(evs, list):
+                events.extend(evs)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_chrome_trace_files(paths: "list[str]", out_path: str) -> dict:
+    """Read per-process trace files (skipping unreadable ones), merge,
+    write the cluster trace to ``out_path``, and return the merged dict."""
+    traces = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                traces.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    merged = merge_chrome_traces(traces)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return merged

@@ -29,6 +29,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "mp: spawns a real multi-process cluster (slower)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture(autouse=True)
 def _clear_injections():
     yield

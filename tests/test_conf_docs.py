@@ -1,0 +1,28 @@
+"""Conf-key / documentation drift gate (ratis_tpu.tools.check_conf_docs):
+every ``*_KEY`` in conf/keys.py must appear in docs/configurations.md and
+vice versa — PRs 2-3 each grew key families the doc silently missed."""
+
+from ratis_tpu.tools.check_conf_docs import check, code_keys, doc_keys
+
+
+def test_conf_keys_and_docs_in_sync():
+    problems = check()
+    assert not problems, "\n".join(problems)
+
+
+def test_parsers_see_real_catalogs():
+    """Guard the checker itself: an empty parse would pass check()
+    vacuously while asserting nothing."""
+    keys = code_keys()
+    assert len(keys) > 80, f"keys.py parse collapsed: {len(keys)} keys"
+    assert "raft.server.rpc.timeout.min" in keys
+    assert "raft.tpu.metrics.http-port" in keys
+    exact, wildcards = doc_keys()
+    assert len(exact) > 60, f"doc parse collapsed: {len(exact)} keys"
+    # suffix alternation expands (min/.max) and multi-segment suffixes
+    # replace one segment (enabled/.warn.threshold)
+    assert "raft.server.rpc.timeout.max" in exact
+    assert "raft.server.pause.monitor.warn.threshold" in exact
+    # family wildcards from table rows count; section headings do not
+    assert "raft.datastream.tls" in wildcards
+    assert "raft.server" not in wildcards

@@ -1,0 +1,452 @@
+"""Cluster observability plane: engine-depth metrics, the per-server
+introspection endpoint (/metrics /health /divisions /events), Prometheus
+exposition conformance, the stall watchdog, the shell ``health``
+subcommand, and cross-process aggregation (metrics/aggregate.py + merged
+Perfetto traces)."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from minicluster import MiniCluster, batched_properties, fast_properties
+from ratis_tpu.metrics.registry import (MetricRegistries, MetricRegistryInfo,
+                                        RatisMetricRegistry, labeled)
+from ratis_tpu.metrics.prometheus import MetricsHttpServer, render_text
+
+
+def _obs_properties(batched: bool = False):
+    p = batched_properties() if batched else fast_properties()
+    p.set("raft.tpu.metrics.http-port", "0")
+    p.set("raft.tpu.watchdog.interval", "150ms")
+    return p
+
+
+# --------------------------------------------- exposition conformance
+
+def _private_regs() -> MetricRegistries:
+    return MetricRegistries()
+
+
+def test_render_escapes_label_values():
+    regs = _private_regs()
+    nasty = 's0@"grp\\one"\nline2'
+    reg = regs.create(MetricRegistryInfo(nasty, "ratis", "test", "esc"))
+    reg.counter("numThings").inc(3)
+    text = render_text(regs)
+    line = next(l for l in text.splitlines() if l.startswith("ratis_test_"))
+    # backslash, quote, and newline all escaped; raw newline never leaks
+    assert r'\\one' in line
+    assert r'\"grp' in line
+    assert r'\n' in line
+    assert "\n" not in line  # the sample stays one exposition line
+
+
+def test_render_counters_get_total_suffix_and_type():
+    regs = _private_regs()
+    reg = regs.create(MetricRegistryInfo("p", "ratis", "test", "ct"))
+    reg.counter("numRequests").inc(7)
+    reg.gauge("depth", lambda: 5)
+    text = render_text(regs)
+    assert "# TYPE ratis_test_numRequests_total counter" in text
+    assert 'ratis_test_numRequests_total{member="p"} 7' in text
+    # gauges keep their bare name
+    assert "# TYPE ratis_test_depth gauge" in text
+    assert 'ratis_test_depth{member="p"} 5' in text
+
+
+def test_render_labeled_counters_merge_member_label():
+    regs = _private_regs()
+    reg = regs.create(MetricRegistryInfo("p", "ratis", "engine", "lc"))
+    reg.counter(labeled("dispatches", reason="sweep")).inc(2)
+    reg.counter(labeled("dispatches", reason="upload")).inc(1)
+    text = render_text(regs)
+    assert ('ratis_engine_dispatches_total{member="p",reason="sweep"} 2'
+            in text)
+    assert ('ratis_engine_dispatches_total{member="p",reason="upload"} 1'
+            in text)
+    # one family, one TYPE line
+    assert text.count("# TYPE ratis_engine_dispatches_total counter") == 1
+
+
+def test_render_groups_families_across_members():
+    """All samples of one family must be consecutive (exposition 0.0.4);
+    the old per-registry walk interleaved families when two members
+    shared a catalog."""
+    regs = _private_regs()
+    for member in ("a", "b"):
+        reg = regs.create(MetricRegistryInfo(member, "ratis", "test", "g"))
+        reg.counter("numX").inc()
+        reg.gauge("y", lambda: 1)
+    lines = render_text(regs).splitlines()
+    families = []
+    for line in lines:
+        fam = (line.split()[3] if line.startswith("# TYPE")
+               else line.split("{")[0])
+        if not families or families[-1] != fam:
+            families.append(fam)
+    # each family appears in exactly one consecutive run
+    assert len(families) == len(set(families)), families
+
+
+def test_render_histogram_as_unitless_summary():
+    regs = _private_regs()
+    reg = regs.create(MetricRegistryInfo("p", "ratis", "engine", "h"))
+    h = reg.histogram("ackBatchSize")
+    for v in (1, 2, 3, 100):
+        h.update(v)
+    text = render_text(regs)
+    assert "# TYPE ratis_engine_ackBatchSize summary" in text
+    assert 'ratis_engine_ackBatchSize_count{member="p"} 4' in text
+    assert 'quantile="0.99"' in text
+    assert "_seconds" not in text  # dimensionless: no unit suffix
+
+
+def test_scrape_during_unregister_race():
+    """A scraper hitting /metrics while another thread churns registry
+    create/remove must always get a 200 and a parseable body — never a
+    500 or a torn read."""
+
+    async def body():
+        regs = MetricRegistries.global_registries()
+        server = MetricsHttpServer()
+        await server.start()
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                info = MetricRegistryInfo(f"race-{i % 7}", "ratis",
+                                          "racetest", "m")
+                reg = regs.create(info)
+                reg.counter("numSpins").inc()
+                reg.gauge("g", lambda: 1)
+                regs.remove(info)
+                i += 1
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            from ratis_tpu.metrics.aggregate import fetch_text
+            for _ in range(30):
+                text = await fetch_text(server.address, "/metrics")
+                for line in text.splitlines():
+                    # every non-empty line is a TYPE comment or a sample;
+                    # a 500 would have raised in fetch_text
+                    assert not line or line.startswith("#") or " " in line
+        finally:
+            stop.set()
+            t.join(5.0)
+            await server.close()
+
+    asyncio.run(body())
+
+
+# ------------------------------------------- engine metrics promotion
+
+def test_engine_metrics_dict_view_and_registry():
+    """engine.metrics keeps the historical dict surface while the same
+    counters live in a real 'engine' registry with the new signals."""
+    from ratis_tpu.engine.engine import QuorumEngine
+
+    async def body():
+        eng = QuorumEngine(max_groups=64, scalar_fallback_threshold=0,
+                           name="view-test")
+        try:
+            m = eng.metrics
+            assert m["ticks"] == 0 and m.get("acks") == 0
+            assert m.get("nope") is None and "nope" not in m
+            assert "ticks" in m and dict(m.items())["ticks"] == 0
+            names = eng._m.registry.metric_names()
+            for expected in ("ticks", "dispatchLatency", "ackBatchSize",
+                             "laneOccupancyGroups", "laneGroupsLive",
+                             'dispatches{reason="sweep"}'):
+                assert expected in names, (expected, names)
+            # the registry is discoverable as an "engine" component
+            infos = [i for i in MetricRegistries.global_registries()
+                     .get_registry_infos()
+                     if i.component == "engine" and i.prefix == "view-test"]
+            assert infos
+        finally:
+            eng._m.unregister()
+
+    asyncio.run(body())
+
+
+# ------------------------------------------- live-cluster endpoints
+
+def test_endpoints_on_live_cluster_and_unset_means_no_listener():
+    """Acceptance: with raft.tpu.metrics.http-port set, /metrics /health
+    /divisions /events all respond on a live 3-peer cluster and the
+    engine lane-occupancy gauges reflect the live group count; with the
+    key unset no listener is created."""
+
+    async def body():
+        from ratis_tpu.metrics.aggregate import (fetch_json, fetch_text,
+                                                 parse_prometheus_text,
+                                                 scrape_cluster)
+        cluster = MiniCluster(3, properties=_obs_properties(batched=True))
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            for _ in range(3):
+                assert (await cluster.send_write()).success
+            srv = cluster.servers[leader.member_id.peer_id]
+            assert srv.metrics_http is not None
+            addr = srv.metrics_http.address
+
+            health = await fetch_json(addr, "/health")
+            assert health["status"] == "ok"
+            assert health["peer"] == str(leader.member_id.peer_id)
+            assert health["engine"]["ticks"] > 0
+            assert health["engine"]["lastTickAgeS"] is not None
+
+            divisions = await fetch_json(addr, "/divisions")
+            assert len(divisions) == 1
+            d = divisions[0]
+            assert d["role"] == "LEADER" and d["term"] >= 1
+            assert d["commitIndex"] >= 3 and d["lastApplied"] >= 3
+            assert d["retryCacheSize"] >= 1
+            assert set(d["followers"]) == {"s%d" % i for i in range(3)} \
+                - {str(leader.member_id.peer_id)}
+            for f in d["followers"].values():
+                assert f["lag"] == 0 and f["matchIndex"] >= 3
+
+            events = await fetch_json(addr, "/events")
+            assert events["enabled"] and events["events"] == []
+
+            samples = parse_prometheus_text(await fetch_text(
+                addr, "/metrics"))
+            member = str(leader.member_id.peer_id)
+            # lane occupancy present and reflecting the live group count
+            assert samples[
+                f'ratis_engine_laneGroupsLive{{member="{member}"}}'] == 1.0
+            cap = samples[
+                f'ratis_engine_laneGroupsCapacity{{member="{member}"}}']
+            assert samples[
+                f'ratis_engine_laneOccupancyGroups{{member="{member}"}}'] \
+                == pytest.approx(1.0 / cap)
+            # the batched engine dispatched, and the division catalog is
+            # scraped alongside
+            assert samples[
+                f'ratis_engine_batched_dispatches_total{{member="{member}"}}'
+            ] > 0
+            assert any(k.startswith("ratis_server_numRaftClientRequests")
+                       for k in samples)
+
+            # cross-server aggregation over the in-process trio
+            merged = await scrape_cluster(
+                [s.metrics_http.address
+                 for s in cluster.servers.values()])
+            assert merged["servers"] == 3 and merged["healthy"] == 3
+            roles = {}
+            for proc in merged["procs"].values():
+                for role, n in proc["roles"].items():
+                    roles[role] = roles.get(role, 0) + n
+            assert roles.get("LEADER") == 1 and roles.get("FOLLOWER") == 2
+        finally:
+            await cluster.close()
+
+        # unset key -> no listener object at all
+        cluster2 = MiniCluster(3)
+        await cluster2.start()
+        try:
+            assert all(s.metrics_http is None
+                       for s in cluster2.servers.values())
+        finally:
+            await cluster2.close()
+
+    asyncio.run(body())
+
+
+# ----------------------------------------------------- stall watchdog
+
+def test_watchdog_detects_commit_stall_and_shell_health(capsys):
+    """Acceptance: an induced commit stall (leader isolated via the
+    existing injection hooks) is detected by the watchdog, visible in
+    /events, and surfaced by the shell ``health`` subcommand."""
+    from ratis_tpu.util import injection
+
+    async def body():
+        from ratis_tpu.metrics.aggregate import fetch_json
+        cluster = MiniCluster(3, properties=_obs_properties())
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            assert (await cluster.send_write()).success
+            srv = cluster.servers[leader.member_id.peer_id]
+            lid = leader.member_id.peer_id
+            # isolate the leader without letting anyone take over: no
+            # staleness abdication, appends and votes both gated
+            for s in cluster.servers.values():
+                s.engine.leadership_timeout_ms = 600_000
+            gate = asyncio.Event()
+
+            async def block(local_id, remote_id, *args):
+                await gate.wait()
+
+            injection.put(injection.APPEND_ENTRIES, block)
+            injection.put(injection.REQUEST_VOTE, block)
+            wtask = asyncio.create_task(
+                cluster.send(b"INCREMENT", server_id=lid, timeout=60.0))
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while asyncio.get_event_loop().time() < deadline:
+                if srv.watchdog.event_count():
+                    break
+                await asyncio.sleep(0.1)
+            events = srv.watchdog.events()
+            assert any(e["kind"] == "commit-stall" for e in events), events
+            # the same journal over the wire
+            payload = await fetch_json(srv.metrics_http.address, "/events")
+            assert payload["count"] >= 1
+            assert any(e["kind"] == "commit-stall"
+                       for e in payload["events"])
+            # the labeled detection counter scraped too
+            from ratis_tpu.metrics.aggregate import (fetch_text,
+                                                     parse_prometheus_text)
+            samples = parse_prometheus_text(
+                await fetch_text(srv.metrics_http.address, "/metrics"))
+            assert samples[
+                f'ratis_server_events_total{{member="{lid}",'
+                f'kind="commit-stall"}}'] >= 1
+
+            # shell health scrapes every endpoint and prints the event
+            import argparse
+            from ratis_tpu.shell.cli import cmd_health
+            args = argparse.Namespace(
+                endpoints=",".join(s.metrics_http.address
+                                   for s in cluster.servers.values()),
+                timeout=10.0, verbose=True)
+            rc = await cmd_health(args)
+            out = capsys.readouterr().out
+            assert "commit-stall" in out
+            assert "3/3 server(s) healthy" in out
+            assert rc == 1  # journaled events -> nonzero exit
+
+            # release: the cluster must recover and commit the write
+            gate.set()
+            injection.clear()
+            reply = await asyncio.wait_for(wtask, 60.0)
+            assert reply.success
+        finally:
+            injection.clear()
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+def test_watchdog_follower_lag_and_churn_units():
+    """Follower-lag: a follower whose appends are dropped falls behind
+    the advancing commit and is journaled once per episode.  Churn: the
+    election-activity rate detector fires from the counters alone."""
+    from ratis_tpu.util import injection
+
+    async def body():
+        cluster = MiniCluster(3, properties=_obs_properties())
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            srv = cluster.servers[leader.member_id.peer_id]
+            srv.watchdog.lag_threshold = 1
+            followers = [d for d in cluster.divisions()
+                         if d.is_follower()]
+            victim = followers[0].member_id.peer_id
+
+            async def drop(local_id, remote_id, *args):
+                if str(local_id).startswith(str(victim)):
+                    raise RuntimeError("injected: follower blackholed")
+
+            injection.put(injection.APPEND_ENTRIES, drop)
+            for _ in range(4):
+                assert (await cluster.send_write()).success
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                if any(e["kind"] == "follower-lag"
+                       for e in srv.watchdog.events()):
+                    break
+                await asyncio.sleep(0.1)
+            lag_events = [e for e in srv.watchdog.events()
+                          if e["kind"] == "follower-lag"]
+            assert lag_events, srv.watchdog.events()
+            assert str(victim) in lag_events[0]["detail"]
+
+            # churn detector: synthetic election activity over threshold
+            srv.watchdog.churn_threshold = 3
+            srv.watchdog.sample()
+            leader.election_metrics.timeout_count.inc(5)
+            srv.watchdog.sample()
+            assert any(e["kind"] == "election-churn"
+                       for e in srv.watchdog.events())
+        finally:
+            injection.clear()
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+# ----------------------------------------- pause monitor registry link
+
+def test_pause_monitor_metrics_in_scrape():
+    async def body():
+        from ratis_tpu.metrics.aggregate import (fetch_text,
+                                                 parse_prometheus_text)
+        cluster = MiniCluster(3, properties=_obs_properties())
+        await cluster.start()
+        try:
+            await cluster.wait_for_leader()
+            srv = next(iter(cluster.servers.values()))
+            srv.pause_monitor.num_pauses.inc()  # simulate one detection
+            srv.pause_monitor.max_pause_s = 0.75
+            samples = parse_prometheus_text(
+                await fetch_text(srv.metrics_http.address, "/metrics"))
+            member = str(srv.peer_id)
+            assert samples[
+                f'ratis_server_numPauses_total{{member="{member}"}}'] == 1.0
+            assert samples[
+                f'ratis_server_longestPauseMs{{member="{member}"}}'] == 750.0
+        finally:
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+# --------------------------------------- multi-process aggregation
+
+@pytest.mark.mp
+def test_multiproc_merged_snapshot_and_trace(tmp_path):
+    """Acceptance: a multi-process rung produces ONE merged cluster
+    snapshot containing every child pid and ONE merged Perfetto trace
+    spanning >= 2 child pids."""
+    from ratis_tpu.tools.bench_cluster import run_multiproc_bench
+
+    trace_out = str(tmp_path / "merged_trace.json")
+
+    async def body():
+        return await run_multiproc_bench(
+            4, 2, num_servers=3, transport="tcp", client_procs=2,
+            concurrency=8, trace=True, trace_sample=1,
+            trace_out=trace_out, bringup_timeout_s=420.0,
+            load_timeout_s=300.0)
+
+    out = asyncio.run(body())
+    assert out["commits"] == 8 and out["write_failures"] == 0
+
+    merged = out["cluster_metrics"]
+    procs = merged["procs"]
+    # every child server process present, each under its own pid
+    assert len(procs) == 3
+    assert all(pid.isdigit() for pid in procs), procs
+    assert len({procs[p]["peer"] for p in procs}) == 3
+    assert merged["healthy"] == 3
+    # counter totals merged across processes: the cluster served commits
+    commits = merged["counter_totals"].get(
+        "ratis_engine_commit_advances_total", 0)
+    assert commits > 0
+
+    # merged chrome trace: valid JSON, spans from >= 2 distinct pids
+    with open(trace_out) as f:
+        trace = json.load(f)
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert len(pids) >= 2, f"merged trace covers pids {pids}"
+    assert out["trace_pids"] == len(pids)

@@ -39,6 +39,14 @@ def test_pause_detected_and_leader_steps_down():
         # may legitimately win re-election immediately afterwards, so do
         # NOT assert on is_leader() here.
         assert srv.pause_monitor.stepdown_count >= 1
+        # detections land in the server registry, not just the log:
+        # numPauses counter + longestPauseMs gauge (and the scrape
+        # renders them as ratis_server_numPauses_total / longestPauseMs)
+        snap = srv.pause_monitor.registry.snapshot()
+        assert snap["numPauses"] == srv.pause_monitor.pause_count
+        assert snap["numPauses"] >= 1
+        assert snap["longestPauseMs"] >= 500.0  # the 1.2s stall, in ms
+        assert snap["numStepDowns"] == srv.pause_monitor.stepdown_count
         # the cluster recovers: a (possibly new) leader serves writes
         await cluster.wait_for_leader()
         assert (await cluster.send_write()).success
