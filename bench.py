@@ -188,7 +188,8 @@ def child_e2e(spec: str) -> None:
                               trace_sample=cfg.get("trace_sample", 16),
                               trace_out=cfg.get("trace_out"),
                               loop_shards=cfg.get("shards", 1),
-                              client_shards=cfg.get("client_shards", 1))
+                              client_shards=cfg.get("client_shards", 1),
+                              extra_props=cfg.get("props"))
         print("RESULT " + json.dumps(out), flush=True)
         # measurement children skip the graceful unwind: closing 50k
         # divisions ran LONGER than the measurement itself; process exit
@@ -707,7 +708,12 @@ def _write_definition() -> None:
         "102400x8.\n"
         "- secondary.wire_sim: host-path decomposition of the traced "
         "1024-group sim rung (stage p50s us + cov), the socket-free "
-        "residual.\n" % (HEADLINE_TRIALS, HEADLINE_GROUPS))
+        "residual.\n"
+        "- secondary.obs: [engine group-lane occupancy, watchdog events "
+        "across headline+flagship, reply-plane scheduling hops per "
+        "commit at the headline shape (metrics/hops.py; the per-request "
+        "chain measures ~2, the waterline fan-out a small fraction)].\n"
+        % (HEADLINE_TRIALS, HEADLINE_GROUPS))
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DEFINITION.md"), "w") as f:
@@ -798,12 +804,17 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
             # headline shape (live rows / padded capacity — the "are we
             # actually batching" signal), watchdog events across the
             # headline + flagship rungs (0 = no stall/churn/lag detected
-            # while the numbers above were measured)]
+            # while the numbers above were measured), reply-plane
+            # scheduling hops per commit at the headline shape (the
+            # round-8 fan-out collapse's standing artifact;
+            # metrics/hops.py — legacy per-request chain measures ~2)]
             "obs": [_median([t.get("engine_occupancy", 0.0)
                              for t in headline]),
                     sum(t.get("watchdog_events", 0) for t in headline)
                     + (peer5.get("watchdog_events", 0)
-                       if isinstance(peer5, dict) else 0)],
+                       if isinstance(peer5, dict) else 0),
+                    _median([t.get("reply_hops_per_commit", 0.0)
+                             for t in headline])],
             "scalar_mode_commits_per_sec": _median(scalar_cps),
             "peer5_10240": {
                 "commits_per_sec": peer5["commits_per_sec"],

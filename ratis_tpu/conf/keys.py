@@ -606,6 +606,58 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Notification.NO_LEADER_TIMEOUT_KEY,
                 RaftServerConfigKeys.Notification.NO_LEADER_TIMEOUT_DEFAULT)
 
+    class Replication:
+        """Replication-plane batching knobs (new; no reference analog —
+        the reference schedules one GrpcLogAppender daemon per (group,
+        follower)).  The sweep discipline converts the replication hot
+        path from per-request/per-group scheduling to batched sweeps:
+        one drain pass per (destination, loop-shard) collects due
+        AppendEntries across ALL co-hosted groups, follower ack frames
+        batch-decode into one packed engine intake, and commit fan-out
+        resolves client waiters through a per-division waterline with one
+        scheduled callback per connection instead of one wakeup chain per
+        request."""
+
+        # Master switch.  0 reproduces the exact per-request paths of the
+        # pre-sweep runtime: per-appender wake->collect->schedule flush
+        # loops, scalar QuorumEngine.on_ack per follower reply, and
+        # per-request reply-future wakeup chains.
+        SWEEP_KEY = "raft.tpu.replication.sweep"
+        SWEEP_DEFAULT = 1
+        # Commit fan-out collapse (requires sweep=1): resolve client
+        # waiters via the per-division commit waterline and deliver
+        # replies through the transport's per-connection batcher (one
+        # scheduled callback per connection per batch).  0 keeps the
+        # per-request reply-future chain while the append sweep and
+        # packed ack intake stay on.
+        REPLY_FANOUT_KEY = "raft.tpu.replication.reply-fanout"
+        REPLY_FANOUT_DEFAULT = 1
+        # Pin DataStream packet handling (stream accept/packet-read work)
+        # to the owning division's loop shard instead of the primary loop
+        # (the attributed structural cause of mixed-rung stream
+        # starvation, docs/perf.md).  Only meaningful with
+        # raft.tpu.server.loop-shards > 1; 0 keeps the primary-loop path.
+        STREAM_SHARDS_KEY = "raft.tpu.replication.stream-shards"
+        STREAM_SHARDS_DEFAULT = 1
+
+        @staticmethod
+        def sweep(p: RaftProperties) -> bool:
+            return p.get_int(
+                RaftServerConfigKeys.Replication.SWEEP_KEY,
+                RaftServerConfigKeys.Replication.SWEEP_DEFAULT) > 0
+
+        @staticmethod
+        def reply_fanout(p: RaftProperties) -> bool:
+            return p.get_int(
+                RaftServerConfigKeys.Replication.REPLY_FANOUT_KEY,
+                RaftServerConfigKeys.Replication.REPLY_FANOUT_DEFAULT) > 0
+
+        @staticmethod
+        def stream_shards(p: RaftProperties) -> bool:
+            return p.get_int(
+                RaftServerConfigKeys.Replication.STREAM_SHARDS_KEY,
+                RaftServerConfigKeys.Replication.STREAM_SHARDS_DEFAULT) > 0
+
     class Engine:
         """TPU batched-quorum engine knobs (new; no reference analog — this
         replaces the reference's thread-per-division daemons)."""

@@ -28,6 +28,7 @@ import numpy as np
 from ratis_tpu.engine.state import (GroupBatchState, NO_DEADLINE,
                                     ROLE_CANDIDATE, ROLE_FOLLOWER,
                                     ROLE_LEADER, ROLE_LISTENER, ROLE_UNUSED)
+from ratis_tpu.metrics.hops import hop
 from ratis_tpu.ops import reference as ref
 from ratis_tpu.trace.tracer import STAGE_ENGINE, TRACER
 
@@ -308,6 +309,9 @@ class QuorumEngine:
         # intake wake the tick via call_soon_threadsafe.  With one loop
         # (the default) every acquisition is uncontended.
         self._lock = threading.RLock()
+        # off-loop wake already scheduled and not yet fired (guarded by
+        # the intake lock): dedupes call_soon_threadsafe notify storms
+        self._wake_pending = False
         self._home_loop: Optional[asyncio.AbstractEventLoop] = None
         # slot -> loop the listener's division runs on (for cross-shard
         # callback dispatch); absent/same-loop listeners take the direct
@@ -350,14 +354,39 @@ class QuorumEngine:
         (P <= 8); the device keeps the work that actually batches — the
         O(G) timeout/staleness/lease sweeps."""
         with self._lock:
-            s = self.state
+            self._on_ack_locked(slot, peer_slot, match_index,
+                                self.clock.now_ms())
+
+    def on_ack_batch(self, rows) -> None:
+        """Packed ack intake: ``rows`` is a sequence of
+        ``(slot, peer_slot, match_index)`` rows (list of tuples or an
+        ``[N, 3]`` int array).  Applies exactly the per-row operations of
+        :meth:`on_ack` — mirror scatter-max, ring append, inline commit —
+        in row order, under ONE intake-lock acquisition, so a follower
+        reply frame carrying N co-hosted groups' acks costs one lock
+        round-trip and (via the wake dedupe in :meth:`_wake_set`) at most
+        one tick wake instead of N.  Commit advancement is bit-identical
+        to feeding the same rows through scalar ``on_ack`` one by one
+        (asserted in tests/test_loop_shards.py)."""
+        if rows is None or len(rows) == 0:
+            return
+        if isinstance(rows, np.ndarray):
+            rows = rows.tolist()
+        with self._lock:
             now = self.clock.now_ms()
-            if s.match_index[slot, peer_slot] < match_index:
-                s.match_index[slot, peer_slot] = match_index
-            if s.last_ack_ms[slot, peer_slot] < now:
-                s.last_ack_ms[slot, peer_slot] = now
-            self._ack_ring.append((slot, peer_slot, match_index, now))
-            self._try_commit_inline(slot, match_index)
+            for slot, peer_slot, match_index in rows:
+                self._on_ack_locked(int(slot), int(peer_slot),
+                                    int(match_index), now)
+
+    def _on_ack_locked(self, slot: int, peer_slot: int, match_index: int,
+                       now: int) -> None:
+        s = self.state
+        if s.match_index[slot, peer_slot] < match_index:
+            s.match_index[slot, peer_slot] = match_index
+        if s.last_ack_ms[slot, peer_slot] < now:
+            s.last_ack_ms[slot, peer_slot] = now
+        self._ack_ring.append((slot, peer_slot, match_index, now))
+        self._try_commit_inline(slot, match_index)
 
     def _try_commit_inline(self, slot: int, hint: int) -> None:
         """Advance ``slot``'s commit from the host mirror if possible and
@@ -431,7 +460,11 @@ class QuorumEngine:
     def _wake_set(self) -> None:
         """Wake the tick loop from any thread: direct on the home loop,
         call_soon_threadsafe from a shard loop (asyncio.Event.set is not
-        thread-safe)."""
+        thread-safe).  Off-loop wakes are DEDUPED under the intake lock:
+        a burst of cross-shard acks/flushes schedules ONE home-loop
+        callback, not one per caller — profiling showed notify storms
+        queueing thousands of redundant call_soon_threadsafe callbacks
+        behind the very tick they all wanted to wake."""
         home = self._home_loop
         if home is not None:
             try:
@@ -439,11 +472,29 @@ class QuorumEngine:
             except RuntimeError:
                 running = None
             if running is not home:
+                with self._lock:
+                    if self._wake_pending:
+                        return  # a scheduled wake already covers this burst
+                    self._wake_pending = True
                 try:
-                    home.call_soon_threadsafe(self._wake.set)
+                    hop("engine_wake")
+                    home.call_soon_threadsafe(self._wake_fire)
                 except RuntimeError:
-                    pass  # home loop closing: nothing left to wake
+                    # home loop closing: nothing left to wake
+                    with self._lock:
+                        self._wake_pending = False
                 return
+        if not self._wake.is_set():
+            hop("engine_wake")
+        self._wake.set()
+
+    def _wake_fire(self) -> None:
+        """Home-loop half of the deduped off-loop wake: clear the pending
+        latch FIRST (a wake requested after this point must schedule a
+        fresh callback — the event below may be consumed immediately),
+        then set the event."""
+        with self._lock:
+            self._wake_pending = False
         self._wake.set()
 
     @staticmethod

@@ -186,6 +186,38 @@ class RaftClientRequest:
                 f"#{self.call_id}:{self.type.type.name}")
 
 
+class _DeferredReply:
+    """Sentinel threaded back through the client-request handler chain when
+    the real :class:`RaftClientReply` will be delivered OUT OF BAND through
+    the request's attached reply sink (the commit fan-out collapse,
+    ``raft.tpu.replication.reply-fanout``): the handler coroutine finishes
+    at append time, and the division's waterline fan-out pushes the reply
+    straight into the transport's per-connection batcher at commit.  Never
+    serialized — transports intercept it before any wire encode."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "<DEFERRED_REPLY>"
+
+
+DEFERRED_REPLY = _DeferredReply()
+
+
+def attach_reply_sink(request: "RaftClientRequest", sink) -> None:
+    """Attach a transport reply sink to ``request`` (out-of-band attribute;
+    the dataclass is frozen but not slotted, and the sink never rides the
+    wire).  ``sink(reply)`` must be callable exactly once, synchronously,
+    from the owning division's loop; the transport is responsible for any
+    cross-loop hand-off back to the connection."""
+    object.__setattr__(request, "_reply_sink", sink)
+
+
+def reply_sink_of(request: "RaftClientRequest"):
+    """The attached reply sink, or None (the per-request reply path)."""
+    return getattr(request, "_reply_sink", None)
+
+
 @dataclasses.dataclass(frozen=True)
 class CommitInfo:
     """peer -> commitIndex, piggybacked on replies (CommitInfoProto:175)."""

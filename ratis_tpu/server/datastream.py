@@ -60,6 +60,10 @@ class StreamInfo:
         # later packets already write — the pipeline); CLOSE drains these
         self.pending: set[asyncio.Task] = set()
         self.failed: Optional[Exception] = None
+        # loop shard owning this stream's handling (the owning division's
+        # shard when the plane is shard-pinned; None = primary loop) —
+        # cleanup must unwind the stream's tasks/connections on this loop
+        self.shard: Optional[int] = None
 
 
 class _RemoteStream:
@@ -113,6 +117,16 @@ class DataStreamManagement:
         self._expiry_s = expiry_s
         self._last_sweep_s = time.monotonic()
         self.metrics = DataStreamMetrics(str(server.peer_id))
+        # Shard-pinned stream plane (raft.tpu.replication.stream-shards):
+        # with loop sharding, each stream's packet handling — channel
+        # writes, successor forwards, ack collection — runs on its OWNING
+        # DIVISION's loop shard instead of the primary loop (the primary
+        # loop's zero-sum cycle share was the attributed cause of
+        # mixed-rung stream starvation, docs/perf.md).  streamId -> shard,
+        # registered at HEADER routing time on the accept loop.
+        self._pin_shards = (server.shards is not None
+                            and getattr(server, "stream_shards", True))
+        self._stream_shards: Dict[int, int] = {}
 
     async def start(self) -> None:
         await self.transport.start()
@@ -126,6 +140,7 @@ class DataStreamManagement:
             await self._cleanup(info)
         self._streams.clear()
         self._links.clear()
+        self._stream_shards.clear()
 
     # ------------------------------------------------------------- packets
 
@@ -142,6 +157,7 @@ class DataStreamManagement:
         for sid in [s for s, i in self._streams.items()
                     if i.touched_s < deadline]:
             info = self._streams.pop(sid)
+            self._stream_shards.pop(sid, None)
             LOG.warning("expiring abandoned datastream %s", sid)
             await self._cleanup(info)
         for key in [k for k, (_, t) in self._links.items() if t < deadline]:
@@ -149,18 +165,50 @@ class DataStreamManagement:
             await self._cleanup(info)
 
     async def _on_packet(self, packet: Packet, conn: PeerConnection) -> None:
-        """Called from the connection's serial read loop.  HEADER and CLOSE
-        are handled fully inline (once per stream).  DATA is PIPELINED: the
-        ordered work — offset check, local channel write, putting the
-        forward copies on the successor sockets — happens inline (so stream
-        order is the read-loop order), but awaiting the successor acks and
-        answering the client moves to a completion task, letting the read
-        loop pull the next packet immediately.  Serialized per-packet
-        round-trips through the whole fan-out chain were the measured
-        throughput ceiling (~0.7 MB/s aggregate at 64KB packets); the
-        reference pipelines exactly this way by chaining per-stream futures
-        (DataStreamManagement.java:85 writeTo/thenCombine chains)."""
+        """Accept-loop entry: route the packet to its stream's pinned loop
+        shard (the owning division's shard) and run the real handler
+        there; unsharded servers — or packets for unknown streams, whose
+        handling is just an error reply — stay on the accept loop.  The
+        read loop awaits this per packet, so per-stream packet order is
+        preserved across the hop."""
         await self._expire_idle()
+        if self._pin_shards:
+            shard = self._route_shard(packet)
+            if shard is not None:
+                await self.server.shards.run_on(
+                    shard, self._handle_packet(packet, conn))
+                return
+        await self._handle_packet(packet, conn)
+
+    def _route_shard(self, packet: Packet) -> Optional[int]:
+        """Loop shard owning ``packet``'s stream: registered at HEADER
+        time from the header's group id (one extra header decode, paid
+        once per stream), looked up for DATA/CLOSE.  None = handle on the
+        accept loop (undecodable header / unknown stream error paths)."""
+        if packet.kind == KIND_HEADER:
+            try:
+                request, _ = decode_header(packet.data)
+            except Exception:
+                return None  # the handler produces the failure reply
+            shard = self.server.shard_of_group(request.group_id)
+            self._stream_shards[packet.stream_id] = shard
+            return shard
+        return self._stream_shards.get(packet.stream_id)
+
+    async def _handle_packet(self, packet: Packet,
+                             conn: PeerConnection) -> None:
+        """The real packet handler (on the stream's pinned loop when
+        sharded).  HEADER and CLOSE are handled fully inline (once per
+        stream).  DATA is PIPELINED: the ordered work — offset check,
+        local channel write, putting the forward copies on the successor
+        sockets — happens inline (so stream order is the read-loop
+        order), but awaiting the successor acks and answering the client
+        moves to a completion task, letting the read loop pull the next
+        packet immediately.  Serialized per-packet round-trips through the
+        whole fan-out chain were the measured throughput ceiling
+        (~0.7 MB/s aggregate at 64KB packets); the reference pipelines
+        exactly this way by chaining per-stream futures
+        (DataStreamManagement.java:85 writeTo/thenCombine chains)."""
         self.metrics.num_requests.inc()
         with self.metrics.request_timer.time():
             reply_data = b""
@@ -218,6 +266,7 @@ class DataStreamManagement:
                              tls=self.tls))
 
         info = StreamInfo(request, is_primary, local, remotes)
+        info.shard = self._stream_shards.get(packet.stream_id)
         self._streams[packet.stream_id] = info
         try:
             forwarded = Packet(KIND_HEADER, packet.stream_id, packet.offset,
@@ -338,6 +387,7 @@ class DataStreamManagement:
         info = self._info_for(packet)
         info.closed = True
         self._streams.pop(packet.stream_id, None)
+        self._stream_shards.pop(packet.stream_id, None)
         await info.local.channel.close()
         for r in info.remotes:  # successors acked the CLOSE already
             await r.close()
@@ -352,6 +402,15 @@ class DataStreamManagement:
         return reply.to_bytes()
 
     async def _cleanup(self, info: StreamInfo) -> None:
+        # a shard-pinned stream's tasks and successor connections are
+        # loop-affine: unwind them on the loop they live on
+        if info.shard is not None and self.server.shards is not None:
+            await self.server.shards.run_on(info.shard,
+                                            self._cleanup_owned(info))
+            return
+        await self._cleanup_owned(info)
+
+    async def _cleanup_owned(self, info: StreamInfo) -> None:
         for t in list(info.pending):
             t.cancel()
         info.pending.clear()

@@ -45,16 +45,19 @@ from ratis_tpu.protocol.raftrpc import (AppendEntriesReply,
                                         AppendEntriesRequest, AppendResult,
                                         RaftRpcHeader, RequestVoteReply,
                                         RequestVoteRequest)
-from ratis_tpu.protocol.requests import (RaftClientReply, RaftClientRequest,
-                                         RequestType)
+from ratis_tpu.metrics.hops import hop
+from ratis_tpu.protocol.requests import (DEFERRED_REPLY, RaftClientReply,
+                                         RaftClientRequest, RequestType,
+                                         reply_sink_of)
 from ratis_tpu.protocol.termindex import INVALID_LOG_INDEX, TermIndex
 from ratis_tpu.server.config import RaftConfiguration
 from ratis_tpu.server.election import LeaderElection
 from ratis_tpu.server.leader import FollowerInfo, LeaderContext
 from ratis_tpu.server.state import ServerState
 from ratis_tpu.server.statemachine import StateMachine, TransactionContext
-from ratis_tpu.trace.tracer import (STAGE_APPEND, STAGE_APPLY, STAGE_REPLY,
-                                    STAGE_REPLICATE, STAGE_TXN, TRACER)
+from ratis_tpu.trace.tracer import (STAGE_APPEND, STAGE_APPLY, STAGE_FANOUT,
+                                    STAGE_REPLY, STAGE_REPLICATE, STAGE_TXN,
+                                    TRACER)
 from ratis_tpu.util import injection
 
 LOG = logging.getLogger(__name__)
@@ -202,6 +205,12 @@ class Division:
         # write handler to close the reply span when its future resumes.
         self._trace_pending: dict[int, tuple[int, int]] = {}
         self._trace_applied: dict[int, tuple[int, int]] = {}
+        # Commit fan-out collapse (raft.tpu.replication.reply-fanout):
+        # the apply loop resolves the batch's client waiters through ONE
+        # waterline fan-out pass, and sink-carrying requests take the
+        # deferred-reply path (reply delivered straight into the
+        # transport's per-connection batcher, no per-request wakeup chain)
+        self._reply_fanout = bool(getattr(server, "reply_fanout", False))
         # peer -> last known commit index (reference CommitInfoCache,
         # RaftServerImpl commitInfoCache): fed by our own commit advances,
         # follower reply piggybacks (leader) and leader request piggybacks
@@ -1275,11 +1284,18 @@ class Division:
 
     # --------------------------------------------------------- leader acks
 
-    def on_follower_ack(self, follower: FollowerInfo) -> None:
+    def on_follower_ack(self, follower: FollowerInfo,
+                        ack_sink: Optional[list] = None) -> None:
         slot = self.peer_slots.get(follower.peer_id)
         if slot is not None and self.engine_slot >= 0:
-            self.server.engine.on_ack(self.engine_slot, slot,
-                                      follower.match_index)
+            if ack_sink is not None:
+                # packed intake (sweep mode): the caller feeds the whole
+                # reply frame's rows to QuorumEngine.on_ack_batch at once
+                ack_sink.append((self.engine_slot, slot,
+                                 follower.match_index))
+            else:
+                self.server.engine.on_ack(self.engine_slot, slot,
+                                          follower.match_index)
         self._update_watch_frontiers()
 
     def on_follower_match_regressed(self, follower: FollowerInfo) -> None:
@@ -1385,12 +1401,16 @@ class Division:
                           if self.state.leader_id is not None else None),
         }
 
-    def on_follower_heartbeat_ack(self, follower: FollowerInfo) -> None:
+    def on_follower_heartbeat_ack(self, follower: FollowerInfo,
+                                  ack_sink: Optional[list] = None) -> None:
         slot = self.peer_slots.get(follower.peer_id)
         if slot is not None and self.engine_slot >= 0:
             # routed as an ack event (match=-1 never regresses the scatter-
             # max) so the device-resident copy sees it without a row refresh
-            self.server.engine.on_ack(self.engine_slot, slot, -1)
+            if ack_sink is not None:
+                ack_sink.append((self.engine_slot, slot, -1))
+            else:
+                self.server.engine.on_ack(self.engine_slot, slot, -1)
         # Heartbeat replies piggyback follower commitIndex: the *_COMMITTED
         # watch frontiers advance on them even with no new matches.
         self._update_watch_frontiers()
@@ -1555,6 +1575,10 @@ class Division:
             self.retry_cache.evict_replied(req.client_id.to_bytes(),
                                            req.replied_call_ids)
         reply = await self._submit_client_request_impl(req)
+        if reply is DEFERRED_REPLY:
+            # deferred-reply fast path: the fan-out callback attaches the
+            # commit infos and hands the real reply to the transport sink
+            return reply
         if reply is not None and not reply.commit_infos:
             import dataclasses
             reply = dataclasses.replace(reply,
@@ -1665,6 +1689,12 @@ class Division:
             try:
                 reply = await self._write_async(req, on_submitted=on_submitted)
                 if not fut.done():
+                    if reply is not DEFERRED_REPLY:
+                        # legacy chain hop #2: this resolution wakes the
+                        # parked _write_ordered handler (deferred replies
+                        # resolve the handler at APPEND time — off the
+                        # commit latency path, so not a commit->reply hop)
+                        hop("reply_window")
                     fut.set_result(reply)
             except asyncio.CancelledError:
                 # division closing: unblock the handler awaiting fut
@@ -1725,9 +1755,35 @@ class Division:
                 if not cache_entry.future.cancelled():
                     raise  # our caller was cancelled, not the entry
 
+        deliver = None
+        sink = reply_sink_of(req) if self._reply_fanout else None
+        if sink is not None:
+            # Deferred-reply fast path: the tail of this method (cache
+            # completion, write-index cache, commit-info piggyback) runs
+            # as ONE synchronous callback from the waterline fan-out, and
+            # the reply lands in the transport's per-connection batcher —
+            # no per-request future-resume chain between commit and wire.
+            def deliver(reply, *, _entry=cache_entry, _req=req,
+                        _sink=sink):
+                import dataclasses  # local like the other reply-path uses
+                try:
+                    if reply.success:
+                        _entry.complete(reply)
+                        self.write_index_cache.put(
+                            _req.client_id.to_bytes(), reply.log_index)
+                    else:
+                        self.metrics.num_failed.inc()
+                        _entry.fail()  # let a retry re-execute
+                    if not reply.commit_infos:
+                        reply = dataclasses.replace(
+                            reply, commit_infos=self.get_commit_infos())
+                    _sink(reply)
+                except Exception:
+                    LOG.exception("%s deferred reply delivery failed",
+                                  self.member_id)
         with self.metrics.write_timer.time():
             try:
-                reply = await self._write_impl(req, on_submitted)
+                reply = await self._write_impl(req, on_submitted, deliver)
             except asyncio.CancelledError:
                 cache_entry.fail()
                 raise
@@ -1740,6 +1796,8 @@ class Division:
                 exc = e if isinstance(e, RaftException) \
                     else RaftException(str(e))
                 return RaftClientReply.failure_reply(req, exc)
+        if reply is DEFERRED_REPLY:
+            return reply  # the registered callback owns the tail above
         if not reply.success:
             self.metrics.num_failed.inc()
         if reply.success:
@@ -1751,7 +1809,7 @@ class Division:
         return reply
 
     async def _write_impl(self, req: RaftClientRequest,
-                          on_submitted=None) -> RaftClientReply:
+                          on_submitted=None, deliver=None) -> RaftClientReply:
         await injection.execute(injection.APPEND_TRANSACTION, self.member_id,
                                 req.client_id)
         tid = req.trace_id if TRACER.enabled else 0
@@ -1798,6 +1856,23 @@ class Division:
         self.leader_ctx.notify_appenders()
         if on_submitted is not None:
             on_submitted()  # appended: the ordered window may release the next
+        if deliver is not None:
+            # Deferred completion: the waterline fan-out invokes the
+            # callback synchronously at commit — this coroutine is done.
+            # No awaits sit between the pending registration above and
+            # here, so the apply loop cannot have raced the registration.
+            def _delivered(reply, *, _idx=index, _tid=tid):
+                if _tid:
+                    done = self._trace_applied.pop(_idx, None)
+                    if done is not None:
+                        # apply done -> fan-out delivery: the reply span
+                        # is now the (batched) fan-out cost, not a task
+                        # resume
+                        TRACER.record(_tid, STAGE_REPLY, done[1],
+                                      TRACER.now())
+                deliver(reply)
+            pending.deliver_to(_delivered)
+            return DEFERRED_REPLY
         reply = await pending.future
         if tid:
             done = self._trace_applied.pop(index, None)
@@ -2102,17 +2177,32 @@ class Division:
             if self._applied_index >= log.get_last_committed_index():
                 await self._apply_wake.wait()
             committed = log.get_last_committed_index()
+            # Waterline reply fan-out (raft.tpu.replication.reply-fanout):
+            # the batch's client waiters are resolved in ONE pass after the
+            # applied frontier reaches the waterline, instead of one
+            # per-entry wakeup chain each (bounded: an oversized backlog
+            # flushes every 64 entries so first replies never wait out a
+            # huge catch-up batch).
+            batch: Optional[list] = [] if self._reply_fanout else None
             while self._applied_index < committed:
                 index = self._applied_index + 1
                 entry = log.get(index)
                 if entry is None:
                     # purged or not yet local (snapshot install in
                     # progress): back off instead of spinning on the gap
+                    if batch:
+                        self._flush_reply_batch(batch)
+                        batch = []
                     await asyncio.sleep(0.05)
                     break
-                await self._apply_one(entry)
+                await self._apply_one(entry, batch)
                 self._applied_index = index
                 sm.update_last_applied_term_index(entry.term, entry.index)
+                if batch is not None and len(batch) >= 64:
+                    self._flush_reply_batch(batch)
+                    batch = []
+            if batch:
+                self._flush_reply_batch(batch)
             self.applied_waiters.advance(self._applied_index)
             log.evict_cache(self._applied_index)
             if self.is_leader() and self.leader_ctx is not None \
@@ -2132,7 +2222,32 @@ class Division:
                 self._last_cache_sweep = now
                 self.retry_cache.sweep()
 
-    async def _apply_one(self, entry: LogEntry) -> None:
+    def _flush_reply_batch(self, batch: list) -> None:
+        """One waterline fan-out pass: resolve every client waiter the
+        applied batch completed.  Sink-carrying requests deliver straight
+        into their transport's per-connection reply batcher (synchronous
+        callback, no task resume); legacy waiters get their futures
+        resolved here — either way the whole batch is one scheduled unit,
+        not one wakeup chain per request (hops metric site
+        ``reply_batch``; span ``server.fanout``)."""
+        hop("reply_batch")
+        t0 = TRACER.now() if TRACER.enabled and TRACER.sample() else 0
+        for pending, exception, message, index in batch:
+            try:
+                if exception is not None:
+                    pending.fail(exception)
+                else:
+                    pending.set_reply(RaftClientReply.success_reply(
+                        pending.request, message=message or Message.EMPTY,
+                        log_index=index))
+            except Exception:
+                LOG.exception("%s reply fan-out failed", self.member_id)
+        if t0:
+            TRACER.record(0, STAGE_FANOUT, t0, TRACER.now(),
+                          tag=len(batch))
+
+    async def _apply_one(self, entry: LogEntry,
+                         reply_batch: Optional[list] = None) -> None:
         sm = self.state_machine
         reply_message: Optional[Message] = None
         exception: Optional[Exception] = None
@@ -2206,7 +2321,12 @@ class Division:
         if self.is_leader() and self.leader_ctx is not None:
             pending = self.leader_ctx.pending.pop(entry.index)
             if pending is not None:
-                if exception is not None:
+                if reply_batch is not None:
+                    # waterline fan-out: the apply loop resolves the whole
+                    # batch in one pass (see _flush_reply_batch)
+                    reply_batch.append((pending, exception, reply_message,
+                                        entry.index))
+                elif exception is not None:
                     pending.fail(exception)
                 else:
                     pending.set_reply(RaftClientReply.success_reply(

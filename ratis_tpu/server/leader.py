@@ -18,6 +18,7 @@ import logging
 import time
 from typing import Optional
 
+from ratis_tpu.metrics.hops import hop
 from ratis_tpu.protocol.exceptions import (NotLeaderException,
                                            ResourceUnavailableException)
 from ratis_tpu.protocol.ids import RaftPeerId
@@ -37,15 +38,38 @@ class PendingRequest:
         self.index = index
         self.request = request
         self.future: asyncio.Future = asyncio.get_running_loop().create_future()
+        # Deferred-reply mode (commit fan-out collapse): a synchronous
+        # completion callback replaces the per-request future wakeup chain
+        # — the waterline fan-out invokes it inline and the reply lands in
+        # the transport's per-connection batcher with no task resume.
+        self._sink_cb = None
+
+    def deliver_to(self, cb) -> None:
+        """Register the deferred completion callback.  If the reply was
+        already set (e.g. a step-down drain raced the append await), the
+        callback fires immediately — exactly-once either way."""
+        self._sink_cb = cb
+        if self.future.done() and not self.future.cancelled():
+            cb(self.future.result())
+
+    def _resolve(self, reply: RaftClientReply) -> None:
+        if self.future.done():
+            return
+        self.future.set_result(reply)
+        cb = self._sink_cb
+        if cb is not None:
+            cb(reply)
+        else:
+            # legacy commit->reply path: this resolution wakes the parked
+            # write-handler task — the per-request hop the waterline
+            # fan-out removes (metric site, see metrics/hops.py)
+            hop("reply_future")
 
     def set_reply(self, reply: RaftClientReply) -> None:
-        if not self.future.done():
-            self.future.set_result(reply)
+        self._resolve(reply)
 
     def fail(self, exception: Exception) -> None:
-        if not self.future.done():
-            self.future.set_result(
-                RaftClientReply.failure_reply(self.request, exception))
+        self._resolve(RaftClientReply.failure_reply(self.request, exception))
 
 
 class PendingRequests:
@@ -372,12 +396,16 @@ class LogAppender:
                         self._epoch, e)
         self._reset_window(backoff_s=self.heartbeat_interval_s)
 
-    async def on_send_reply(self, item, reply: AppendEntriesReply) -> None:
+    async def on_send_reply(self, item, reply: AppendEntriesReply,
+                            ack_sink: Optional[list] = None) -> None:
+        """``ack_sink`` (sweep mode): collect this reply's engine ack as a
+        packed row instead of a scalar on_ack call — the PeerSender feeds
+        the whole envelope's rows to QuorumEngine.on_ack_batch at once."""
         if item.epoch != self._epoch or not self._running:
             return  # window was reset while this was in flight
         if item.pipelined:
             self._inflight -= 1
-        await self._on_reply(item.request, reply, item.epoch)
+        await self._on_reply(item.request, reply, item.epoch, ack_sink)
 
     def _spawn(self, coro) -> None:
         t = asyncio.create_task(coro)
@@ -482,7 +510,8 @@ class LogAppender:
         return base + (1,) if hibernate else base
 
     async def on_bulk_reply(self, code: int, term: int, next_index: int,
-                            follower_commit: int, flush_index: int) -> None:
+                            follower_commit: int, flush_index: int,
+                            ack_sink: Optional[list] = None) -> None:
         """Dispatch one aligned BulkHeartbeatReply item.  Happy path keeps
         the follower fresh (staleness + watch frontiers); any anomaly
         escalates to a full AppendEntries probe on the data path, which
@@ -504,7 +533,7 @@ class LogAppender:
             self.hibernate_acked = True
             f = self.follower
             f.last_rpc_response_s = time.monotonic()
-            div.on_follower_heartbeat_ack(f)
+            div.on_follower_heartbeat_ack(f, ack_sink)
             return
         self.hibernate_acked = False  # any other reply: timer is armed
         if code != BULK_HB_OK:
@@ -517,7 +546,7 @@ class LogAppender:
         if follower_commit > f.commit_index:
             f.commit_index = follower_commit
             div.update_commit_info(f.peer_id, follower_commit)
-        div.on_follower_heartbeat_ack(f)
+        div.on_follower_heartbeat_ack(f, ack_sink)
         log = div.state.log
         if (next_index < f.next_index and self._inflight == 0
                 and not self._busy):
@@ -531,7 +560,8 @@ class LogAppender:
             self.sender.mark(self)  # data pending: wake the fill path
 
     async def _on_reply(self, request: AppendEntriesRequest,
-                        reply: AppendEntriesReply, epoch: int) -> None:
+                        reply: AppendEntriesReply, epoch: int,
+                        ack_sink: Optional[list] = None) -> None:
         div = self.division
         if reply.term > div.state.current_term:
             await div.change_to_follower(reply.term, leader_id=None,
@@ -552,9 +582,9 @@ class LogAppender:
                                   else -1))
             confirmed = min(reply.match_index, last_covered)
             if self.follower.update_match(confirmed):
-                div.on_follower_ack(self.follower)
+                div.on_follower_ack(self.follower, ack_sink)
             else:
-                div.on_follower_heartbeat_ack(self.follower)
+                div.on_follower_heartbeat_ack(self.follower, ack_sink)
         elif reply.result == AppendResult.INCONSISTENCY:
             if epoch == self._epoch:
                 # observable reorder/rewind churn (ADVICE r5): the keyed

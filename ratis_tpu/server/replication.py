@@ -30,11 +30,25 @@ import asyncio
 import logging
 from typing import NamedTuple, Optional
 
+from ratis_tpu.metrics.hops import hop
 from ratis_tpu.protocol.exceptions import TimeoutIOException
 from ratis_tpu.protocol.ids import RaftPeerId
-from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope)
+from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope,
+                                        AppendResult)
 
 LOG = logging.getLogger(__name__)
+
+
+class _LoopSweep:
+    """Per-(event-loop) sweep state: the senders marked due on that loop
+    and whether a drain pass is already scheduled.  Only ever touched from
+    its own loop's thread."""
+
+    __slots__ = ("due", "armed")
+
+    def __init__(self) -> None:
+        self.due: dict["PeerSender", None] = {}
+        self.armed = False
 
 
 class OutItem(NamedTuple):
@@ -62,7 +76,8 @@ class PeerSender:
 
     def __init__(self, server, to: RaftPeerId, *, coalescing: bool,
                  inflight_cap: int, envelope_byte_limit: int,
-                 metrics: Optional[dict] = None):
+                 metrics: Optional[dict] = None, sweep: bool = False,
+                 scheduler: "Optional[ReplicationScheduler]" = None):
         self.server = server
         self.to = to
         self.coalescing = coalescing
@@ -75,23 +90,91 @@ class PeerSender:
         # with loop sharding there is one sender per (destination, shard),
         # and the scheduler's close() must unwind it on this loop
         self.loop = asyncio.get_running_loop()
+        # Sweep mode (raft.tpu.replication.sweep): NO standing flush-loop
+        # task — marks register this sender with the scheduler's per-loop
+        # sweep, and one scheduled drain pass collects across every due
+        # sender on the loop.  sweep=0 keeps the per-sender wake-event
+        # flush loop exactly as before.
+        self.sweep = sweep
+        self.scheduler = scheduler
         self._wake = asyncio.Event()
-        self._slots = asyncio.Semaphore(max(1, inflight_cap))
+        self._task: Optional[asyncio.Task] = None
+        if sweep:
+            self._slots = None
+            self._slots_free = max(1, inflight_cap)
+        else:
+            self._slots = asyncio.Semaphore(max(1, inflight_cap))
+            self._slots_free = 0
         self._running = True
         self._inflight_tasks: set[asyncio.Task] = set()
-        self._task = asyncio.create_task(
-            self._run(), name=f"sender-{server.peer_id}->{to}")
+        if not sweep:
+            self._task = asyncio.create_task(
+                self._run(), name=f"sender-{server.peer_id}->{to}")
 
     # -- intake ---------------------------------------------------------------
 
     def mark(self, appender) -> None:
         """Register an appender as having (potential) work toward this
-        destination and wake the flush loop."""
+        destination and wake the flush loop (legacy) or arm the loop's
+        cross-group sweep pass (sweep mode)."""
         self._dirty[appender] = None
-        self._wake.set()
+        if self.sweep:
+            if self._running:
+                self.scheduler.arm_sweep(self)
+        else:
+            if not self._wake.is_set():
+                hop("sender_wake")
+            self._wake.set()
 
     def unmark(self, appender) -> None:
         self._dirty.pop(appender, None)
+
+    # -- sweep mode: scheduler-driven drain pass ------------------------------
+
+    def sweep_collect(self) -> None:
+        """One drain pass over this sender's dirty appenders (called from
+        the scheduler's per-loop sweep).  Collects multi-group envelopes
+        until the dirty set or the in-flight slots run out; with the
+        in-flight cap reached, the remaining dirty appenders keep their
+        marks and the slot release re-arms the sweep."""
+        server = self.server
+        while self._running and self._dirty and self._slots_free > 0:
+            items: list[OutItem] = []
+            budget = self.envelope_byte_limit
+            while self._dirty and budget > 0:
+                a = next(iter(self._dirty))
+                del self._dirty[a]
+                try:
+                    budget -= a.collect(items, budget)
+                except Exception:
+                    LOG.exception("%s->%s collect failed for %s",
+                                  server.peer_id, self.to, a)
+            if not items:
+                return
+            self.metrics["envelopes"] += 1
+            self.metrics["items"] += len(items)
+            if self.coalescing:
+                self._slots_free -= 1
+                t = asyncio.create_task(self._send(items))
+                self._inflight_tasks.add(t)
+                t.add_done_callback(self._inflight_tasks.discard)
+            else:
+                # reference cost shape, swept: the drain pass is shared but
+                # each collected batch still ships as its own unary RPC
+                # with per-reply window refill (see _run's unary branch)
+                for it in items:
+                    it.appender.envelope_done(remark=False)
+                    t = asyncio.create_task(self._send_unary(it))
+                    self._inflight_tasks.add(t)
+                    t.add_done_callback(self._inflight_tasks.discard)
+
+    def _release_slot(self) -> None:
+        if self.sweep:
+            self._slots_free += 1
+            if self._dirty and self._running:
+                self.scheduler.arm_sweep(self)
+        else:
+            self._slots.release()
 
     # -- flush loop -----------------------------------------------------------
 
@@ -163,13 +246,19 @@ class PeerSender:
                           self.server.peer_id, self.to)
         finally:
             it.appender.notify()  # refill the window per reply
-            self._wake.set()
+            if not self.sweep:
+                self._wake.set()
 
     async def _send(self, items: list[OutItem]) -> None:
         server = self.server
         replies: list = []
         error: Optional[Exception] = None
         remark = True
+        # Packed ack intake (sweep mode): every SUCCESS reply in this
+        # envelope contributes one [slot, peer_slot, match] row here
+        # instead of a scalar QuorumEngine.on_ack call, and the whole
+        # frame batch enters the engine under ONE intake-lock round-trip.
+        ack_rows: Optional[list] = [] if self.sweep else None
         # One outer try/finally owns the latch + slot: ANY exit (including
         # cancellation from a source other than close(), which used to skip
         # the slot release and wedge the sender after inflight_cap events)
@@ -199,18 +288,33 @@ class PeerSender:
                     if rep is None:
                         rep = TimeoutIOException(
                             f"{self.to} failed this group's append")
+                    if ack_rows and (isinstance(rep, Exception)
+                                     or rep.result != AppendResult.SUCCESS):
+                        # Ordering guard: a non-SUCCESS dispatch can
+                        # REGRESS a follower's match (INCONSISTENCY after
+                        # a volatile-log restart, via regress_match) — the
+                        # rows buffered so far must enter the engine FIRST
+                        # or the later batch apply would scatter-max a
+                        # stale ack back over the regression.  Exactly the
+                        # scalar path's interleaving, batched between
+                        # anomalies (which are rare on the hot path).
+                        server.engine.on_ack_batch(ack_rows)
+                        ack_rows = []
                     if isinstance(rep, Exception):
                         it.appender.on_send_error(it, rep)
                     else:
-                        await it.appender.on_send_reply(it, rep)
+                        await it.appender.on_send_reply(it, rep, ack_rows)
                 except Exception:
                     LOG.exception("%s->%s reply dispatch failed",
                                   server.peer_id, self.to)
+            if ack_rows:
+                server.engine.on_ack_batch(ack_rows)
         finally:
             for a in {it.appender for it in items}:
                 a.envelope_done(remark=remark)
-            self._slots.release()
-            self._wake.set()
+            self._release_slot()
+            if not self.sweep:
+                self._wake.set()
 
     async def close(self) -> None:
         self._running = False
@@ -221,7 +325,7 @@ class PeerSender:
         # task we are currently running in.
         cur = asyncio.current_task()
         tasks = [t for t in (self._task, *self._inflight_tasks)
-                 if t is not cur]
+                 if t is not None and t is not cur]
         self._inflight_tasks.clear()
         for t in tasks:
             t.cancel()
@@ -237,11 +341,20 @@ class ReplicationScheduler:
     (created lazily; peers are few even when groups are many)."""
 
     def __init__(self, server, *, coalescing: bool, inflight_cap: int,
-                 envelope_byte_limit: int):
+                 envelope_byte_limit: int, sweep: bool = False):
         self.server = server
         self.coalescing = coalescing
         self.inflight_cap = inflight_cap
         self.envelope_byte_limit = envelope_byte_limit
+        # Cross-group append sweeps (raft.tpu.replication.sweep): marks
+        # arm ONE drain pass per (loop, burst) that collects due
+        # AppendEntries across every destination's dirty appenders on
+        # that loop, replacing the per-sender wake->collect->schedule
+        # flush-loop wakeups.  Off (0) = the per-request legacy path.
+        self.sweep = sweep
+        # loop key -> _LoopSweep; each entry is only touched from its own
+        # loop's thread (marks and drain passes are loop-affine)
+        self._sweeps: dict[int, _LoopSweep] = {}
         # keyed by (destination, calling loop): with loop sharding each
         # shard gets its own sender per destination — its flush task and
         # outbound connection live on the shard's loop, so one shard's
@@ -280,9 +393,39 @@ class ReplicationScheduler:
             s = PeerSender(self.server, to, coalescing=self.coalescing,
                            inflight_cap=self.inflight_cap,
                            envelope_byte_limit=self.envelope_byte_limit,
-                           metrics=self.metrics)
+                           metrics=self.metrics, sweep=self.sweep,
+                           scheduler=self)
             self._senders[key] = s
         return s
+
+    # -- sweep mode: one drain pass per (loop, burst) -------------------------
+
+    def arm_sweep(self, sender: PeerSender) -> None:
+        """Register ``sender`` as due and schedule at most ONE drain pass
+        on its loop for the current scheduling burst.  All marks issued in
+        the same event-loop pass — however many groups and destinations —
+        fold into that single callback; call_soon runs it after the
+        in-progress burst finishes marking, the same micro-batching the
+        per-sender flush loop got from its post-wake ``sleep(0)``."""
+        key = self._loop_key()
+        st = self._sweeps.get(key)
+        if st is None:
+            st = self._sweeps[key] = _LoopSweep()
+        st.due[sender] = None
+        if not st.armed:
+            st.armed = True
+            hop("sender_wake")
+            sender.loop.call_soon(self._sweep_pass, st)
+
+    def _sweep_pass(self, st: _LoopSweep) -> None:
+        st.armed = False
+        due, st.due = st.due, {}
+        for sender in due:
+            try:
+                sender.sweep_collect()
+            except Exception:
+                LOG.exception("replication sweep pass failed for %s",
+                              sender.to)
 
     def acquire(self, to: RaftPeerId, appender) -> PeerSender:
         """sender_for + register ``appender`` as a user; pair with

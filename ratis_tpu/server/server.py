@@ -26,7 +26,8 @@ from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope,
                                         InstallSnapshotRequest,
                                         ReadIndexRequest, RequestVoteRequest,
                                         StartLeaderElectionRequest)
-from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.protocol.requests import (DEFERRED_REPLY, RaftClientReply,
+                                         RaftClientRequest)
 from ratis_tpu.protocol.termindex import TermIndex
 from ratis_tpu.server.division import Division
 from ratis_tpu.server.statemachine import StateMachine
@@ -203,14 +204,21 @@ class BulkHeartbeatService:
             LOG.warning("%s: bulk heartbeat reply misaligned from %s",
                         self.server.peer_id, to)
             return  # items re-qualify next sweep (see send-failure note)
+        # packed ack intake (sweep mode): the whole bulk's heartbeat acks
+        # enter the engine as one on_ack_batch instead of one scalar
+        # on_ack (and one intake-lock round-trip) per item
+        ack_rows = ([] if getattr(self.server, "replication_sweep", False)
+                    else None)
         for appender, item in zip(appenders, reply.items):
             try:
-                await appender.on_bulk_reply(*item)
+                await appender.on_bulk_reply(*item, ack_sink=ack_rows)
             except asyncio.CancelledError:
                 raise
             except Exception:
                 LOG.exception("%s bulk heartbeat reply dispatch failed",
                               self.server.peer_id)
+        if ack_rows:
+            self.server.engine.on_ack_batch(ack_rows)
 
     async def close(self) -> None:
         for task in list(self._pending):
@@ -289,13 +297,37 @@ class RaftServer:
             RaftServerConfigKeys.Heartbeat.coalescing_enabled(p)
         # Data-path fan-out: one PeerSender per destination server drains
         # every group's append batches (ratis_tpu.server.replication).
+        # The sweep discipline (raft.tpu.replication.*) batches the whole
+        # replication plane: cross-group append sweeps per (destination,
+        # loop-shard), packed ack intake (engine.on_ack_batch), and the
+        # commit fan-out collapse; sweep=0 keeps the per-request paths.
         from ratis_tpu.server.replication import ReplicationScheduler
         appender_keys = RaftServerConfigKeys.Log.Appender
+        repl_keys = RaftServerConfigKeys.Replication
+        self.replication_sweep = repl_keys.sweep(p)
+        self.reply_fanout = (self.replication_sweep
+                             and repl_keys.reply_fanout(p))
+        self.stream_shards = repl_keys.stream_shards(p)
         self.replication = ReplicationScheduler(
             self,
             coalescing=appender_keys.coalescing_enabled(p),
             inflight_cap=appender_keys.envelope_inflight(p),
-            envelope_byte_limit=appender_keys.envelope_byte_limit(p))
+            envelope_byte_limit=appender_keys.envelope_byte_limit(p),
+            sweep=self.replication_sweep)
+        # scheduling-hops-per-commit: the fan-out collapse as a standing
+        # measured artifact (metrics/hops.py; per-site gauges + the
+        # hops-per-commit ratio on this server's registry)
+        from ratis_tpu.metrics import hops as hops_mod
+        from ratis_tpu.metrics.registry import (MetricRegistries,
+                                                MetricRegistryInfo, labeled)
+        self._plane_info = MetricRegistryInfo(
+            prefix=str(peer_id), application="ratis", component="server",
+            name="replication_plane")
+        plane = MetricRegistries.global_registries().create(self._plane_info)
+        for site in hops_mod.HOP_SITES:
+            plane.gauge(labeled("schedulingHops", site=site),
+                        lambda s=site: hops_mod.snapshot()[s])
+        plane.gauge("replyHopsPerCommit", self.reply_hops_per_commit)
         # single source of truth for the heartbeat cadence (LeaderContext
         # and the sweep must agree, or heartbeat gaps silently grow)
         self.heartbeat_interval_s = \
@@ -474,6 +506,8 @@ class RaftServer:
                 await self.shards.run_on(sched.shard, sched.service.close())
         self._hb_shards.clear()
         await self.replication.close()
+        from ratis_tpu.metrics.registry import MetricRegistries
+        MetricRegistries.global_registries().remove(self._plane_info)
         await self.engine.close()
         if self.shards is not None:
             await self.shards.close()
@@ -875,6 +909,11 @@ class RaftServer:
         except Exception as e:  # never leak raw errors to the wire
             LOG.exception("%s request failed", self.peer_id)
             return RaftClientReply.failure_reply(request, RaftException(str(e)))
+        if reply is DEFERRED_REPLY:
+            # deferred-reply fast path: the waterline fan-out delivers the
+            # real reply through the request's transport sink at commit
+            # (the respond span is recorded there, not via mark_egress)
+            return reply
         if trace_t0:
             # the transport pops this to close the respond span (handler
             # done -> reply serialized/handed back)
@@ -939,6 +978,18 @@ class RaftServer:
             LOG.exception("%s group management failed", self.peer_id)
             return RaftClientReply.failure_reply(request, RaftException(str(e)))
         return RaftClientReply.success_reply(request)
+
+    def reply_hops_per_commit(self) -> float:
+        """Reply-plane scheduling hops per commit advance — the fan-out
+        collapse's standing metric.  Hops are PROCESS-wide (co-hosted
+        servers share the counters, like the tracer); the commit
+        denominator is this server's engine, so in a one-server-per-
+        process deployment the ratio is exact and in an in-process test
+        cluster it is a per-server upper bound (the bench divides by the
+        cluster-wide commit sum instead)."""
+        from ratis_tpu.metrics import hops as hops_mod
+        commits = max(1, self.engine.metrics["commit_advances"])
+        return round(hops_mod.reply_plane_hops() / commits, 4)
 
     def resolve_peer_address(self, peer_id: RaftPeerId) -> Optional[str]:
         return self.peer_addresses.get(peer_id)

@@ -161,10 +161,15 @@ def bench_properties(batched: bool, num_groups: int = 1,
     else:
         # the reference's cost shape: one Python pass per group per event
         # (thread-per-division EventProcessor analog) and one RPC per
-        # (group, follower) batch (GrpcLogAppender.java:356 stream-per-pair).
+        # (group, follower) batch (GrpcLogAppender.java:356 stream-per-pair)
+        # — and per-request replication scheduling (per-appender flush-loop
+        # wakes, scalar on_ack per reply, per-request reply chains): the
+        # round-8 sweep discipline is a batched-mode optimization, so the
+        # baseline keeps the pre-sweep paths.
         p.set("raft.tpu.engine.scalar-fallback-threshold", "1000000000")
         p.set(RaftServerConfigKeys.Log.Appender.COALESCING_ENABLED_KEY, "false")
         p.set(RaftServerConfigKeys.Heartbeat.COALESCING_ENABLED_KEY, "false")
+        p.set(RaftServerConfigKeys.Replication.SWEEP_KEY, "0")
     return p
 
 
@@ -793,6 +798,10 @@ def _mp_server_main() -> None:
                         if server.watchdog is not None else 0),
                     "append_rewinds":
                         server.replication.metrics.get("rewinds", 0),
+                    # one server per process: the process-wide hop
+                    # counters line up exactly with this engine's commits
+                    "reply_hops_per_commit":
+                        server.reply_hops_per_commit(),
                 }
                 if spec.get("trace"):
                     from ratis_tpu.trace import get_tracer
@@ -1132,6 +1141,8 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
             report = json.loads(rep[len("MPREPORT "):])
             result["append_rewinds"] = report.get("append_rewinds", 0)
             result["engine_occupancy"] = report.get("engine_occupancy")
+            result["reply_hops_per_commit"] = report.get(
+                "reply_hops_per_commit")
             if trace and "host_path_decomposition" in report:
                 result["host_path_decomposition"] = \
                     report["host_path_decomposition"]
@@ -1208,7 +1219,8 @@ async def run_bench(num_groups: int, writes_per_group: int,
                     trace_sample: int = 16,
                     trace_out: "str | None" = None,
                     loop_shards: int = 1,
-                    client_shards: int = 1) -> dict:
+                    client_shards: int = 1,
+                    extra_props: Optional[dict] = None) -> dict:
     """One ladder rung: build the ``num_servers``-server cluster, elect,
     warm up, measure, tear down.  ``teardown=False`` skips the graceful
     close: a measurement child that exits right after reporting has no
@@ -1222,7 +1234,7 @@ async def run_bench(num_groups: int, writes_per_group: int,
                           sm=sm, num_servers=num_servers,
                           hibernate=hibernate, mesh_devices=mesh_devices,
                           trace=trace, trace_sample=trace_sample,
-                          loop_shards=loop_shards)
+                          loop_shards=loop_shards, extra_props=extra_props)
     cluster = await cm.__aenter__()
     try:
         if hibernate and settle_s:
@@ -1242,10 +1254,21 @@ async def run_bench(num_groups: int, writes_per_group: int,
             # decompose the MEASURED window only, not warmup/bring-up
             from ratis_tpu.trace import get_tracer
             get_tracer().reset()
+        # hops-per-commit over the MEASURED window only (the fan-out
+        # collapse's standing artifact; metrics/hops.py)
+        from ratis_tpu.metrics import hops as hops_mod
+        engines = [s.engine for s in cluster.servers]
+        hops_mod.reset()
+        commits_before = sum(e.metrics["commit_advances"] for e in engines)
         result = await cluster.run_load(writes_per_group, concurrency,
                                         message_factory=mf,
                                         active_groups=active_groups,
                                         client_shards=client_shards)
+        commit_delta = sum(e.metrics["commit_advances"]
+                           for e in engines) - commits_before
+        result["scheduling_hops"] = hops_mod.snapshot()
+        result["reply_hops_per_commit"] = round(
+            hops_mod.reply_plane_hops() / max(1, commit_delta), 3)
         if trace:
             from ratis_tpu.trace import get_tracer
             from ratis_tpu.trace.export import (host_path_decomposition,
@@ -1262,7 +1285,6 @@ async def run_bench(num_groups: int, writes_per_group: int,
                 import os
                 write_chrome_trace(trace_out, records)
                 result["trace_out"] = os.path.abspath(trace_out)
-        engines = [s.engine for s in cluster.servers]
         result["batched_dispatches"] = sum(
             e.metrics["batched_dispatches"] for e in engines)
         result["engine_ticks"] = sum(e.metrics["ticks"] for e in engines)
@@ -1421,7 +1443,8 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
                           transport: str = "sim",
                           loop_shards: int = 1,
                           client_shards: int = 1,
-                          stream_window: int = 16) -> dict:
+                          stream_window: int = 16,
+                          extra_props: Optional[dict] = None) -> dict:
     """BASELINE config 5 analog: filestore + DataStream mixed load.
 
     Every group runs a FileStore state machine; the bulk load is ordinary
@@ -1438,7 +1461,8 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
     async with _started_cluster(num_groups, batched, sm="filestore",
                                 datastream=True, transport=transport,
                                 num_servers=num_servers,
-                                loop_shards=loop_shards) as cluster:
+                                loop_shards=loop_shards,
+                                extra_props=extra_props) as cluster:
         stream_stats = {"ok": 0, "failed": 0, "bytes": 0, "elapsed_s": 0.0}
         payload = b"\x5a" * stream_bytes
 

@@ -91,13 +91,60 @@ def doc_keys(path: str = DOCS_MD) -> tuple[set[str], set[str]]:
     return exact, wildcards
 
 
+def doc_rows(path: str = DOCS_MD) -> dict[str, tuple[str, str]]:
+    """key -> (default cell, meaning cell) for every key named in the
+    first cell of a markdown table row.  Suffix alternation expands the
+    same way as :func:`doc_keys`, and all expanded keys share the row's
+    default/meaning cells (the doc writes them as ``a / b`` pairs)."""
+    rows: dict[str, tuple[str, str]] = {}
+    for line in open(path):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if len(cells) < 3 or set(cells[0]) <= {"-", " ", ":"}:
+            continue  # not a row, or the |---|---| separator
+        last: str | None = None
+        for m in _DOC_TOKEN_RE.finditer(cells[0]):
+            token = m.group(1) or m.group(2)
+            for part in token.split("/"):
+                if not part:
+                    continue
+                if part.startswith("raft."):
+                    key = part[:-2] + ".*" if part.endswith(".*") else part
+                    rows[key] = (cells[1], cells[2])
+                    last = part if part.startswith("raft.") \
+                        and not part.endswith(".*") else last
+                elif part.startswith(".") and last is not None:
+                    key = last.rsplit(".", 1)[0] + part
+                    rows[key] = (cells[1], cells[2])
+                    last = key
+    return rows
+
+
 def check() -> list[str]:
     """Drift findings; empty = code and docs agree."""
     code = code_keys()
     exact, wildcards = doc_keys()
+    rows = doc_rows()
     problems: list[str] = []
     for key in sorted(code):
         if key in exact:
+            # exact documentation must be a TABLE row carrying a default
+            # and a meaning — a bare mention in prose reads as documented
+            # while telling an operator nothing (the round-8 tightening:
+            # every key gets a default-and-meaning row)
+            row = rows.get(key)
+            if row is None:
+                covered = any(key.startswith(w + ".") for w in wildcards)
+                if not covered:
+                    problems.append(
+                        f"key has no default-and-meaning table row in "
+                        f"docs/configurations.md: {key}")
+            elif not row[0] or not row[1]:
+                problems.append(
+                    f"table row for {key} is missing its "
+                    f"{'default' if not row[0] else 'meaning'} cell")
             continue
         if any(key.startswith(w + ".") for w in wildcards):
             continue

@@ -67,13 +67,16 @@ STAGE_REPLY = 9       # server.reply — apply done -> write handler resumes
 STAGE_RESPOND = 10    # server.respond — server handler done -> reply handed
                       # back to the transport / written to the socket
 STAGE_ENGINE = 11     # engine.dispatch — one quorum-engine tick dispatch
-NUM_STAGES = 12
+STAGE_FANOUT = 12     # server.fanout — one waterline reply fan-out pass
+                      # (batch of committed requests resolved in one unit;
+                      # tag = batch size; process-level like engine.dispatch)
+NUM_STAGES = 13
 
 STAGE_NAMES = (
     "client.send", "codec.encode", "codec.decode", "wire.rtt",
     "server.route", "server.txn_start", "server.append",
     "server.replicate", "server.apply", "server.reply", "server.respond",
-    "engine.dispatch",
+    "engine.dispatch", "server.fanout",
 )
 
 # Stages whose durations tile the per-request path (no mutual overlap):

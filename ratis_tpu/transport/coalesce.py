@@ -98,6 +98,23 @@ class WriteCoalescer:
             self._flusher = asyncio.create_task(self._flush_after_delay())
         await fut
 
+    def send_nowait(self, frame, nbytes: int) -> None:
+        """Fire-and-forget enqueue: the frame joins the pending batch and
+        the flusher (armed at most once per batch) writes it out on the
+        next pass — REGARDLESS of the flush thresholds, so deferred-reply
+        fan-out batches coalesce even on a connection configured for the
+        per-frame path.  No backpressure: callers are reply producers
+        whose volume is bounded by the connection's in-flight requests; a
+        dead coalescer drops the frame (the connection is gone and its
+        client will retry/timeout exactly as with a torn socket)."""
+        if self._dead is not None:
+            return
+        self._pending.append(frame)
+        self._pending_bytes += nbytes
+        if self._flusher is None:
+            self._flusher = asyncio.get_running_loop().create_task(
+                self._flush_after_delay())
+
     async def _flush_after_delay(self) -> None:
         try:
             while self._pending and self._dead is None:

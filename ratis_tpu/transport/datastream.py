@@ -104,15 +104,29 @@ PacketHandler = Callable[[Packet, "PeerConnection"], Awaitable[None]]
 
 
 class PeerConnection:
-    """One accepted connection; the handler replies via :meth:`send`."""
+    """One accepted connection; the handler replies via :meth:`send`.
+
+    Loop-aware: with the DataStream plane pinned to division loop shards
+    (raft.tpu.replication.stream-shards) the packet handlers — and their
+    reply sends — run on shard loops while the accepted socket lives on
+    the accept loop; a cross-loop send hops back to the owner (StreamWriter
+    is loop-affine).  Single-loop servers take the direct path."""
 
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter) -> None:
         self.reader = reader
         self.writer = writer
         self._send_lock = asyncio.Lock()
+        self._loop = asyncio.get_running_loop()
 
     async def send(self, packet: Packet) -> None:
+        if asyncio.get_running_loop() is not self._loop:
+            await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                self._send_owned(packet), self._loop))
+            return
+        await self._send_owned(packet)
+
+    async def _send_owned(self, packet: Packet) -> None:
         async with self._send_lock:
             self.writer.write(encode_packet(packet))
             await self.writer.drain()

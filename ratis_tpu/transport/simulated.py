@@ -19,7 +19,9 @@ from typing import Optional
 
 from ratis_tpu.protocol.exceptions import TimeoutIOException
 from ratis_tpu.protocol.ids import RaftPeerId
-from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.protocol.requests import (DEFERRED_REPLY, RaftClientReply,
+                                         RaftClientRequest,
+                                         attach_reply_sink)
 from ratis_tpu.trace.tracer import (INGRESS_NS, STAGE_RESPOND, STAGE_WIRE,
                                     TRACER)
 from ratis_tpu.transport.base import (ClientRequestHandler, ClientTransport,
@@ -132,10 +134,43 @@ class SimulatedNetwork:
         if self.is_blocked(None, target.peer_id):
             raise TimeoutIOException(f"simulated: client->{target.peer_id} blocked")
         await self._hop_delay()
+        # Deferred-reply sink (commit fan-out collapse): the division's
+        # waterline fan-out resolves this future directly — the handler
+        # coroutine chain finishes at append time, so the commit->reply
+        # path is one future resolution instead of the resume chain.  The
+        # division engages it only when its server runs with
+        # raft.tpu.replication.reply-fanout; otherwise the sink is unused.
+        loop = asyncio.get_running_loop()
+        sink_fut: asyncio.Future = loop.create_future()
+        sink_ns = [0]
+
+        def _set(reply: RaftClientReply) -> None:
+            if not sink_fut.done():
+                sink_fut.set_result(reply)
+
+        def _sink(reply: RaftClientReply) -> None:
+            sink_ns[0] = TRACER.now() if TRACER.enabled else 0
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is loop:
+                _set(reply)
+            else:
+                try:
+                    loop.call_soon_threadsafe(_set, reply)
+                except RuntimeError:
+                    pass  # client loop gone (teardown)
+
+        attach_reply_sink(request, _sink)
+        timeout_s = self.client_request_timeout_s
         tid = request.trace_id if TRACER.enabled else 0
         if not tid:
-            return await asyncio.wait_for(target.client_handler(request),
-                                          self.client_request_timeout_s)
+            reply = await asyncio.wait_for(target.client_handler(request),
+                                           timeout_s)
+            if reply is DEFERRED_REPLY:
+                reply = await asyncio.wait_for(sink_fut, timeout_s)
+            return reply
         # wire span over a direct function call: ~the server wall — the
         # same overlap shape the socket transports record, so a trace read
         # in Perfetto has the hop lane on every transport
@@ -143,14 +178,18 @@ class SimulatedNetwork:
         INGRESS_NS.set(t0)  # wait_for's task copies this context: the
         # handler's route span starts at ingress, not at task start
         try:
-            return await asyncio.wait_for(target.client_handler(request),
-                                          self.client_request_timeout_s)
+            reply = await asyncio.wait_for(target.client_handler(request),
+                                           timeout_s)
+            if reply is DEFERRED_REPLY:
+                reply = await asyncio.wait_for(sink_fut, timeout_s)
+            return reply
         finally:
             now = TRACER.now()
-            egress = TRACER.pop_egress(tid)
+            egress = TRACER.pop_egress(tid) or sink_ns[0]
             if egress:
-                # handler done -> this coroutine resumed: the hand-back
-                # task-switch hop (the sim's whole "reply write" cost)
+                # handler done (or fan-out delivery) -> this coroutine
+                # resumed: the hand-back task-switch hop (the sim's whole
+                # "reply write" cost)
                 TRACER.record(tid, STAGE_RESPOND, egress, now)
             TRACER.record(tid, STAGE_WIRE, t0, now)
 

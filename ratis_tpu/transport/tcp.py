@@ -21,12 +21,15 @@ import logging
 import struct
 from typing import Callable, Dict, Optional
 
+from ratis_tpu.metrics.hops import hop
 from ratis_tpu.protocol.exceptions import (RaftException, TimeoutIOException,
                                            exception_from_wire,
                                            exception_to_wire)
 from ratis_tpu.protocol.ids import RaftPeerId
 from ratis_tpu.protocol.raftrpc import decode_rpc, encode_rpc
-from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.protocol.requests import (DEFERRED_REPLY, RaftClientReply,
+                                         RaftClientRequest,
+                                         attach_reply_sink)
 from ratis_tpu.trace.tracer import (INGRESS_NS, STAGE_DECODE, STAGE_ENCODE,
                                     STAGE_RESPOND, STAGE_WIRE, TRACER)
 from ratis_tpu.transport.base import (ClientRequestHandler, ClientTransport,
@@ -73,6 +76,80 @@ def _flush_conf(properties) -> tuple[int, int]:
     from ratis_tpu.conf.keys import WireConfigKeys
     return (WireConfigKeys.Tcp.flush_bytes(properties),
             WireConfigKeys.Tcp.flush_micros(properties))
+
+
+def _defer_conf(properties) -> bool:
+    """Whether client requests get a deferred-reply sink attached (the
+    commit fan-out collapse, raft.tpu.replication.sweep/reply-fanout)."""
+    if properties is None:
+        return False
+    from ratis_tpu.conf.keys import RaftServerConfigKeys
+    K = RaftServerConfigKeys.Replication
+    return K.sweep(properties) and K.reply_fanout(properties)
+
+
+class _DeferredReplyFanout:
+    """Per-connection deferred-reply batcher: the division's waterline
+    fan-out calls :meth:`submit` synchronously (possibly from a shard
+    loop); replies queue here and ONE armed callback per burst drains them
+    into the connection's write coalescer — one scheduled hop per batch
+    per connection, replacing the per-request handler-resume + send-wait
+    chain the traced decomposition measured as ``server.reply`` /
+    ``server.respond``."""
+
+    __slots__ = ("_conn_out", "_loop", "_q", "_lock", "_armed")
+
+    def __init__(self, conn_out: "_StreamFrameCoalescer",
+                 loop: asyncio.AbstractEventLoop) -> None:
+        import collections
+        import threading
+        self._conn_out = conn_out
+        self._loop = loop
+        self._q = collections.deque()
+        self._lock = threading.Lock()
+        self._armed = False
+
+    def sink_for(self, call_seq: int, trace_id: int = 0):
+        def sink(reply: RaftClientReply) -> None:
+            self.submit(call_seq, reply, trace_id)
+        return sink
+
+    def submit(self, call_seq: int, reply: RaftClientReply,
+               trace_id: int = 0) -> None:
+        tid = trace_id if TRACER.enabled else 0
+        t0 = TRACER.now() if tid else 0
+        # encode on the CALLING (division) loop: serialization stays off
+        # the connection's loop, which only performs the buffered write
+        body = reply.to_bytes()
+        frame = _encode_frame(call_seq, KIND_REPLY, body)
+        with self._lock:
+            self._q.append((frame, tid, t0, len(body)))
+            if self._armed:
+                return
+            self._armed = True
+        hop("reply_flush")
+        try:
+            self._loop.call_soon_threadsafe(self._drain)
+        except RuntimeError:
+            pass  # connection loop closed: the client sees a torn socket
+
+    def _drain(self) -> None:
+        with self._lock:
+            items = list(self._q)
+            self._q.clear()
+            self._armed = False
+        now = TRACER.now() if TRACER.enabled else 0
+        for frame, tid, t0, nbody in items:
+            try:
+                self._conn_out.send_nowait(frame, len(frame))
+            except Exception:
+                return  # connection dead; remaining frames undeliverable
+            if tid and t0:
+                # respond span (deferred shape): reply ready at the
+                # division -> handed to this connection's batched write
+                # path (the flush itself is the coalescer's single
+                # write+drain per batch)
+                TRACER.record(tid, STAGE_RESPOND, t0, now, tag=nbody)
 
 
 async def _read_frame(reader: asyncio.StreamReader):
@@ -321,7 +398,8 @@ class TcpServerTransport(ServerTransport):
                                                   Optional[str]]] = None,
                  request_timeout_s: float = 3.0,
                  tls: "TcpTlsConfig | None" = None,
-                 flush_bytes: int = 0, flush_micros: int = 0):
+                 flush_bytes: int = 0, flush_micros: int = 0,
+                 defer_replies: bool = False):
         self.peer_id = peer_id
         self._address = address
         self._bound_port: Optional[int] = None
@@ -332,6 +410,10 @@ class TcpServerTransport(ServerTransport):
         self.tls = tls
         self.flush_bytes = flush_bytes
         self.flush_micros = flush_micros
+        # commit fan-out collapse: attach a per-connection deferred-reply
+        # sink to client requests (the division decides per request
+        # whether to engage it; see _DeferredReplyFanout)
+        self.defer_replies = defer_replies
         self._server: Optional[asyncio.AbstractServer] = None
         self._pool = _ConnectionPool(tls=tls, flush_bytes=flush_bytes,
                                      flush_micros=flush_micros)
@@ -351,6 +433,8 @@ class TcpServerTransport(ServerTransport):
         # fold into one buffered flush + one drain per batch
         conn_out = _StreamFrameCoalescer(writer, self.flush_bytes,
                                          self.flush_micros)
+        fanout = (_DeferredReplyFanout(conn_out, asyncio.get_running_loop())
+                  if self.defer_replies else None)
         tasks: set[asyncio.Task] = set()
         try:
             while True:
@@ -360,7 +444,8 @@ class TcpServerTransport(ServerTransport):
                 # handle concurrently: one slow consensus RPC must not
                 # head-of-line-block the connection (gRPC gives this for
                 # free; here we spawn per-call tasks)
-                t = asyncio.create_task(self._serve_one(frame, conn_out))
+                t = asyncio.create_task(
+                    self._serve_one(frame, conn_out, fanout))
                 tasks.add(t)
                 t.add_done_callback(tasks.discard)
         except (ConnectionError, OSError):
@@ -379,10 +464,12 @@ class TcpServerTransport(ServerTransport):
             except (ConnectionError, OSError):
                 pass
 
-    async def _serve_one(self, frame,
-                         conn_out: _StreamFrameCoalescer) -> None:
+    async def _serve_one(self, frame, conn_out: _StreamFrameCoalescer,
+                         fanout: "Optional[_DeferredReplyFanout]" = None
+                         ) -> None:
         call_seq, kind, body = frame
         trace_tid = trace_egress = 0
+        client_reply = False
         try:
             if kind == KIND_SERVER_RPC:
                 reply = await self.server_handler(decode_rpc(body))
@@ -395,9 +482,18 @@ class TcpServerTransport(ServerTransport):
                     TRACER.record(request.trace_id, STAGE_DECODE, t0,
                                   now, tag=len(body))
                     INGRESS_NS.set(now)  # route span starts post-decode
+                if fanout is not None:
+                    attach_reply_sink(
+                        request, fanout.sink_for(call_seq,
+                                                 request.trace_id))
                 reply = await self.client_handler(request)
+                if reply is DEFERRED_REPLY:
+                    # reply rides the per-connection fan-out batcher at
+                    # commit; this task is done at append time
+                    return
                 trace_tid = request.trace_id
                 trace_egress = TRACER.pop_egress(trace_tid)
+                client_reply = True
                 out_kind, out = KIND_REPLY, reply.to_bytes()
             else:
                 raise RaftException(f"unexpected frame kind {kind}")
@@ -410,6 +506,12 @@ class TcpServerTransport(ServerTransport):
             out_kind, out = KIND_ERROR, msgpack.packb(
                 exception_to_wire(exc), use_bin_type=True)
         try:
+            if client_reply:
+                # per-request commit->reply hop #3 (legacy path): this
+                # task suspends for the send/drain — the deferred-reply
+                # fan-out replaces it with one drain arm per connection
+                # per burst (metrics/hops.py reply_send vs reply_flush)
+                hop("reply_send")
             reply_frame = _encode_frame(call_seq, out_kind, out)
             await conn_out.send(reply_frame, len(reply_frame))
             if trace_egress:
@@ -512,7 +614,8 @@ class TcpTransportFactory(TransportFactory):
                                   client_handler, peer_resolver=peer_resolver,
                                   request_timeout_s=timeout_s,
                                   tls=TcpTlsConfig.from_properties(properties),
-                                  flush_bytes=fb, flush_micros=fm)
+                                  flush_bytes=fb, flush_micros=fm,
+                                  defer_replies=_defer_conf(properties))
 
     def new_client_transport(self, properties=None) -> ClientTransport:
         fb, fm = _flush_conf(properties)

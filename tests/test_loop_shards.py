@@ -121,6 +121,196 @@ def test_engine_intake_from_worker_threads():
     asyncio.run(body())
 
 
+def test_engine_batch_intake_from_worker_threads():
+    """Same coherence contract as the scalar-intake hammer above, driven
+    through the packed batch API (QuorumEngine.on_ack_batch): no lost
+    rows, no torn mirror state, ring fully drained."""
+    from ratis_tpu.engine.engine import QuorumEngine
+
+    async def body():
+        eng = QuorumEngine(max_groups=64, max_peers=8,
+                           tick_interval_s=0.001,
+                           scalar_fallback_threshold=10**9)
+
+        class Listener:
+            async def on_election_timeout(self):
+                pass
+
+            async def on_commit_advance(self, c):
+                pass
+
+            async def on_leadership_stale(self):
+                pass
+
+        slots = [eng.attach(Listener()) for _ in range(8)]
+        await eng.start()
+        try:
+            iters = 400
+
+            def hammer(k: int) -> None:
+                for i in range(iters):
+                    eng.on_ack_batch([(slot, (k + 1) % 8, i)
+                                      for slot in slots])
+                    for slot in slots:
+                        eng.on_flush(slot, i)
+
+            await asyncio.gather(
+                *(asyncio.to_thread(hammer, k) for k in range(4)))
+            for _ in range(50):
+                await asyncio.sleep(0.005)
+                if not eng._ack_ring and not eng._slot_updates:
+                    break
+            assert not eng._ack_ring, "ack ring never drained"
+            s = eng.state
+            for slot in slots:
+                assert int(s.flush_index[slot]) == iters - 1
+            assert eng.metrics["acks"] == 4 * iters * len(slots), \
+                "batch intake lost acks across threads"
+        finally:
+            await eng.close()
+            for slot in slots:
+                eng.detach(slot)
+
+    asyncio.run(body())
+
+
+def test_ack_batch_bit_identical_to_scalar_intake():
+    """Randomized ack/flush sequences fed through scalar on_ack vs chunked
+    on_ack_batch must yield identical commit indices, identical flush
+    state, and the identical inline commit-callback order (the round-8
+    equivalence contract: the packed intake is a locking/batching change,
+    never a math change)."""
+    import random
+
+    import numpy as np
+
+    from ratis_tpu.engine.engine import QuorumEngine
+    from ratis_tpu.engine.state import ROLE_LEADER
+
+    def run(batched: bool):
+        eng = QuorumEngine(max_groups=32, max_peers=8,
+                           scalar_fallback_threshold=10**9)
+        calls: list[tuple[int, int]] = []
+
+        class Rec:
+            def __init__(self, ident: int) -> None:
+                self.ident = ident
+
+            def on_commit_advance_now(self, commit: int) -> None:
+                calls.append((self.ident, commit))
+
+            async def on_commit_advance(self, commit: int) -> None:
+                self.on_commit_advance_now(commit)
+
+            async def on_election_timeout(self) -> None:
+                pass
+
+            async def on_leadership_stale(self) -> None:
+                pass
+
+        slots = []
+        st = eng.state
+        for i in range(8):
+            slot = eng.attach(Rec(i))
+            slots.append(slot)
+            cur = np.zeros(8, bool)
+            cur[:3] = True  # 3-peer conf, self at column 0
+            st.set_conf(slot, 0, cur, np.zeros(8, bool),
+                        np.zeros(8, np.int32), 0)
+            st.role[slot] = ROLE_LEADER
+            st.first_leader_index[slot] = 0
+        rng = random.Random(1234)
+        events = []
+        for _ in range(600):
+            slot = slots[rng.randrange(8)]
+            if rng.random() < 0.25:
+                events.append(("flush", slot, rng.randrange(0, 120)))
+            else:
+                events.append(("ack", slot, rng.randrange(1, 3),
+                               rng.randrange(0, 120)))
+        i = 0
+        chunk_rng = random.Random(99)
+        while i < len(events):
+            kind = events[i][0]
+            if kind == "flush" or not batched:
+                if kind == "flush":
+                    eng.on_flush(events[i][1], events[i][2])
+                else:
+                    eng.on_ack(events[i][1], events[i][2], events[i][3])
+                i += 1
+                continue
+            # batched: take the maximal run of consecutive acks, feed it
+            # through on_ack_batch in random-size chunks
+            j = i
+            while j < len(events) and events[j][0] == "ack":
+                j += 1
+            run_rows = [(e[1], e[2], e[3]) for e in events[i:j]]
+            k = 0
+            while k < len(run_rows):
+                n = chunk_rng.randrange(1, 17)
+                eng.on_ack_batch(run_rows[k:k + n])
+                k += n
+            i = j
+        commits = [int(st.commit_index[s]) for s in slots]
+        flushes = [int(st.flush_index[s]) for s in slots]
+        ring = [(g, p, m) for g, p, m, _t in eng._ack_ring]
+        eng._m.unregister()
+        return commits, flushes, calls, ring
+
+    assert run(False) == run(True)
+
+
+def test_cross_shard_engine_wakes_dedupe_to_one():
+    """A burst of cross-thread intake wakes must schedule ONE home-loop
+    call_soon_threadsafe callback, not one per caller (ISSUE 5 bugfix:
+    coalesce pending notify wakes under the intake lock).  Deterministic:
+    the home loop's thread is blocked in join() for the whole burst, so
+    the armed wake cannot fire-and-clear mid-burst."""
+    import numpy as np
+
+    from ratis_tpu.engine.engine import QuorumEngine
+    from ratis_tpu.engine.state import ROLE_LEADER
+    from ratis_tpu.metrics import hops as hops_mod
+
+    async def body():
+        eng = QuorumEngine(max_groups=8, max_peers=8,
+                           scalar_fallback_threshold=10**9)
+
+        class L:  # no on_commit_advance_now: every ack wakes the tick
+            async def on_election_timeout(self):
+                pass
+
+            async def on_commit_advance(self, c):
+                pass
+
+            async def on_leadership_stale(self):
+                pass
+
+        slot = eng.attach(L())
+        st = eng.state
+        cur = np.zeros(8, bool)
+        cur[:3] = True
+        st.set_conf(slot, 0, cur, np.zeros(8, bool), np.zeros(8, np.int32), 0)
+        st.role[slot] = ROLE_LEADER
+        eng._home_loop = asyncio.get_running_loop()
+
+        def burst() -> None:
+            for i in range(200):
+                eng.on_ack(slot, 1, i + 1)
+
+        hops_mod.reset()
+        t = threading.Thread(target=burst)
+        t.start()
+        t.join()  # blocks the home loop: no wake can fire mid-burst
+        assert hops_mod.snapshot()["engine_wake"] == 1, \
+            "a 200-ack burst must schedule exactly one notify wake"
+        await asyncio.sleep(0)  # let the armed wake fire and clear
+        assert not eng._wake_pending
+        eng._m.unregister()
+
+    asyncio.run(body())
+
+
 # -------------------------------------------------- sharded cluster e2e
 
 def test_sharded_cluster_routes_and_pins_divisions():

@@ -1,0 +1,133 @@
+"""Round-8 replication-plane batching (raft.tpu.replication.*).
+
+Covers the sweep discipline's three contracts: the batch-off configuration
+(sweep=0) still serves the full write path through the legacy per-request
+code, the batched configuration produces the same commits, and the
+scheduling-hops-per-commit metric — the fan-out collapse's standing
+artifact — drops at least 2x on the in-process 64-group sim rung.  The
+hops assertion is deterministic by construction (counter arithmetic, no
+timing): the legacy commit->reply chain counts exactly two scheduling
+operations per committed ordered write (pending-future + ordered-window
+resolutions), while the waterline fan-out counts at most one batch pass
+per committed entry.
+"""
+
+import asyncio
+
+import pytest
+
+
+def _drive_ordered(cluster, writes_per_group: int, pipeline: int):
+    """Drive every group with `pipeline` concurrent ordered writes per
+    round through the real RaftClient OrderedApi (slider seqNums), so the
+    legacy path exercises both hops of its reply chain."""
+    from ratis_tpu.client import RaftClient
+
+    async def one_group(g):
+        client = (RaftClient.builder()
+                  .set_raft_group(g)
+                  .set_transport(cluster.factory.new_client_transport(
+                      cluster.properties))
+                  .set_properties(cluster.properties)
+                  .build())
+        try:
+            io = client.io()
+            for _ in range(writes_per_group):
+                replies = await asyncio.gather(
+                    *(io.send(b"INCREMENT") for _ in range(pipeline)))
+                assert all(r.success for r in replies)
+        finally:
+            await client.close()
+
+    return asyncio.gather(*(one_group(g) for g in cluster.groups))
+
+
+async def _measured_rung(sweep: bool, groups: int = 64) -> dict:
+    """One in-process sim rung (scalar engine: no jit warmup cost) with the
+    replication sweep on/off; returns the measured hops-per-commit."""
+    from ratis_tpu.metrics import hops as hops_mod
+    from ratis_tpu.tools.bench_cluster import BenchCluster
+
+    cluster = BenchCluster(
+        groups, num_servers=3, batched=False, transport="sim",
+        extra_props={
+            "raft.tpu.replication.sweep": "1" if sweep else "0",
+            "raft.tpu.replication.reply-fanout": "1" if sweep else "0",
+        })
+    await cluster.start()
+    try:
+        engines = [s.engine for s in cluster.servers]
+        assert all(s.replication_sweep == sweep for s in cluster.servers)
+        hops_mod.reset()
+        commits0 = sum(e.metrics["commit_advances"] for e in engines)
+        await _drive_ordered(cluster, writes_per_group=2, pipeline=4)
+        commits = sum(e.metrics["commit_advances"]
+                      for e in engines) - commits0
+        assert commits >= groups * 2 * 4 * 0.9, "rung lost commits"
+        snap = hops_mod.snapshot()
+        return {
+            "commits": commits,
+            "hops": snap,
+            "reply_hpc": hops_mod.reply_plane_hops() / max(1, commits),
+        }
+    finally:
+        await cluster.close()
+
+
+@pytest.mark.parametrize("sweep", [False, True])
+def test_rung_completes_both_modes(sweep):
+    """sweep=0 must reproduce a fully working per-request path; sweep=1
+    must commit the identical workload."""
+    out = asyncio.run(_measured_rung(sweep, groups=8))
+    assert out["commits"] >= 8 * 2 * 4 * 0.9
+
+
+def test_hops_per_commit_drops_2x_on_64group_sim_rung():
+    """The acceptance bar: reply-plane scheduling hops per commit drop
+    >= 2x with the sweep + fan-out collapse on the 64-group sim rung."""
+
+    async def body():
+        legacy = await _measured_rung(False)
+        swept = await _measured_rung(True)
+        return legacy, swept
+
+    legacy, swept = asyncio.run(body())
+    # legacy: pending-future + ordered-window task wakeups per commit;
+    # batch passes must not appear (fan-out disabled)
+    assert legacy["hops"]["reply_batch"] == 0
+    assert legacy["reply_hpc"] >= 1.9, legacy
+    # swept: the per-request wakeup chain is gone; deliveries run inside
+    # synchronous waterline passes (reply_batch counts passes for batch-
+    # size observability, not hops) and the sim transport needs no flush
+    # arm, so the scheduled reply plane is (near) empty
+    assert swept["hops"]["reply_future"] == 0, swept
+    assert swept["hops"]["reply_window"] == 0, swept
+    assert swept["hops"]["reply_batch"] > 0, swept
+    assert swept["reply_hpc"] <= 0.5, swept
+    assert legacy["reply_hpc"] >= 2 * max(swept["reply_hpc"], 0.25), \
+        (legacy, swept)
+
+
+def test_sweep_mode_has_no_standing_sender_tasks():
+    """Sweep-mode PeerSenders are drained by scheduler passes, not by a
+    per-sender flush-loop task (the per-appender wake->collect->schedule
+    shape the sweep replaces); legacy senders keep the standing task."""
+    from ratis_tpu.tools.bench_cluster import BenchCluster
+
+    async def body(sweep: bool) -> list:
+        cluster = BenchCluster(
+            4, num_servers=3, batched=False, transport="sim",
+            extra_props={"raft.tpu.replication.sweep":
+                         "1" if sweep else "0"})
+        await cluster.start()
+        try:
+            await _drive_ordered(cluster, writes_per_group=1, pipeline=2)
+            senders = [s2 for srv in cluster.servers
+                       for s2 in srv.replication._senders.values()]
+            assert senders, "load produced no senders"
+            return [s2._task for s2 in senders]
+        finally:
+            await cluster.close()
+
+    assert all(t is None for t in asyncio.run(body(True)))
+    assert all(t is not None for t in asyncio.run(body(False)))

@@ -399,7 +399,7 @@ def test_bench_summary_line_fits_driver_window():
         out = {"commits_per_sec": 123456.8, "p50_ms": 99999.99,
                "p99_ms": 99999.99, "election_convergence_s": 9999.99,
                "write_failures": 0, "engine_occupancy": 0.9999,
-               "watchdog_events": 99999}
+               "watchdog_events": 99999, "reply_hops_per_commit": 99.999}
         out.update(extra)
         return out
 
@@ -448,6 +448,7 @@ def test_bench_summary_line_fits_driver_window():
     assert parsed["secondary"]["p5_fs"][2] == 32
     assert parsed["secondary"]["readmix"][1] == 123456.8
     assert parsed["secondary"]["snap_1024"][1] == 10240
-    # observability keys: [engine occupancy, watchdog event count]
-    assert parsed["secondary"]["obs"] == [0.9999, 99999 * 6]
+    # observability keys: [engine occupancy, watchdog event count,
+    # reply-plane scheduling hops per commit (round-8 fan-out collapse)]
+    assert parsed["secondary"]["obs"] == [0.9999, 99999 * 6, 99.999]
     assert "batched_commits_per_sec" in parsed["secondary"]["grpc_1024"]
