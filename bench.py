@@ -562,6 +562,21 @@ def main() -> None:
                               "writes": WRITES_PER_GROUP, "batched": False,
                               "concurrency": 128, "transport": "tcp"})
     scalar = _run_trials(scalar_spec, HEADLINE_TRIALS)
+    # Round-9 append-window depth sweep on the headline TCP rung,
+    # back-to-back with the headline trials (same box state): depth 1 is
+    # the latched stop-and-wait-per-group fallback, so the depth-1 vs
+    # default delta attributes throughput to the pipelined append round
+    # trip; each entry records [commits/s, p99 ms, window occupancy].
+    win_sweep: dict = {}
+    for d in (1, 4, 16):
+        r = _run_child(["--e2e-child", json.dumps(
+            {"groups": HEADLINE_GROUPS, "writes": WRITES_PER_GROUP,
+             "batched": True, "concurrency": 128, "transport": "tcp",
+             "props": {"raft.tpu.replication.window-depth": str(d)}})],
+            timeout_s=900.0, allow_dnf=True)
+        win_sweep[str(d)] = ({"dnf": True} if r.get("dnf") else
+                             [r["commits_per_sec"], r["p99_ms"],
+                              r.get("window_occupancy", 0.0)])
     # gRPC at HEADLINE scale (the reference's primary RPC stack analog):
     # batched envelopes+streams at 1024 groups; the scalar
     # per-(group,follower) unary shape is attempted at the same scale and
@@ -636,7 +651,8 @@ def main() -> None:
         churn=churn, mixed=mixed, stream=stream, grpc_b=grpc_b,
         grpc_s_1024=grpc_s_1024, grpc_s_256=grpc_s_256, kernel=kernel,
         kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced,
-        filestore5=filestore5, readmix=readmix, snapcatch=snapcatch),
+        filestore5=filestore5, readmix=readmix, snapcatch=snapcatch,
+        win_sweep=win_sweep),
         separators=(",", ":")))
 
 
@@ -712,7 +728,14 @@ def _write_definition() -> None:
         "- secondary.obs: [engine group-lane occupancy, watchdog events "
         "across headline+flagship, reply-plane scheduling hops per "
         "commit at the headline shape (metrics/hops.py; the per-request "
-        "chain measures ~2, the waterline fan-out a small fraction)].\n"
+        "chain measures ~2, the waterline fan-out a small fraction), "
+        "append-window occupancy (peak frames in flight / envelope "
+        "slots, raft.tpu.replication.window-depth)].\n"
+        "- secondary.win_sweep: round-9 window-depth sweep on the "
+        "headline TCP rung, depth -> [commits/s, p99 ms, window "
+        "occupancy]; depth 1 is the latched stop-and-wait-per-group "
+        "fallback, so depth-1 vs default attributes the gain to the "
+        "pipelined append round trip (docs/replication.md).\n"
         % (HEADLINE_TRIALS, HEADLINE_GROUPS))
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -750,7 +773,7 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                peer5_grpc_scalar, peer7, sparse_hib, sparse_plain, churn,
                mixed, stream, grpc_b, grpc_s_1024, grpc_s_256, kernel,
                kernel_100k, tpu_e2e, traced, filestore5, readmix,
-               snapcatch) -> dict:
+               snapcatch, win_sweep=None) -> dict:
     """Build the one-line JSON summary.  COMPACT by contract: the whole
     line must parse from the driver's 2000-char tail window (r5 lost its
     flagship number to overflow), so keys are short, numbers rounded, and
@@ -814,7 +837,13 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                     + (peer5.get("watchdog_events", 0)
                        if isinstance(peer5, dict) else 0),
                     _median([t.get("reply_hops_per_commit", 0.0)
+                             for t in headline]),
+                    # round-9 append-window occupancy (peak frames in
+                    # flight / envelope slots) at the headline shape
+                    _median([t.get("window_occupancy", 0.0)
                              for t in headline])],
+            # window-depth sweep: depth -> [c/s, p99 ms, occupancy]
+            "win_sweep": win_sweep or {},
             "scalar_mode_commits_per_sec": _median(scalar_cps),
             "peer5_10240": {
                 "commits_per_sec": peer5["commits_per_sec"],

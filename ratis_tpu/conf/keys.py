@@ -639,6 +639,28 @@ class RaftServerConfigKeys:
         # raft.tpu.server.loop-shards > 1; 0 keeps the primary-loop path.
         STREAM_SHARDS_KEY = "raft.tpu.replication.stream-shards"
         STREAM_SHARDS_DEFAULT = 1
+        # Sequenced append-window pipelining (round 9, reference analog:
+        # GrpcLogAppender's per-follower sliding window,
+        # GrpcLogAppender.java:343-381, batched across groups): a group may
+        # contribute entries to up to this many consecutive in-flight
+        # multi-group frames per (destination, loop-shard) lane.  Frames
+        # carry lane/sequence numbers and the follower's sweep intake
+        # processes them in lane order, so per-group FIFO no longer needs
+        # the one-frame-per-group busy latch.  1 = exactly the latched
+        # (stop-and-wait per group) behavior — the deterministic fallback
+        # and the scalar-reference cost shape.  Only effective with
+        # sweep=1 and appender coalescing on.
+        WINDOW_DEPTH_KEY = "raft.tpu.replication.window-depth"
+        WINDOW_DEPTH_DEFAULT = 4
+        # Follower-side lane intake: frames parked past a sequence HOLE
+        # (a lower seq never arrived) are briefly buffered — up to this
+        # many per lane — waiting for the gap to fill; beyond it (or
+        # after the gap wait times out) the frame is rejected with a
+        # rewind hint and the sender re-cuts the lane.  In-order frames
+        # queued behind a busy predecessor are ordinary pipelining,
+        # bounded separately (RaftServer._LANE_QUEUE_MAX).
+        REORDER_BUFFER_KEY = "raft.tpu.replication.reorder-buffer"
+        REORDER_BUFFER_DEFAULT = 8
 
         @staticmethod
         def sweep(p: RaftProperties) -> bool:
@@ -657,6 +679,18 @@ class RaftServerConfigKeys:
             return p.get_int(
                 RaftServerConfigKeys.Replication.STREAM_SHARDS_KEY,
                 RaftServerConfigKeys.Replication.STREAM_SHARDS_DEFAULT) > 0
+
+        @staticmethod
+        def window_depth(p: RaftProperties) -> int:
+            return max(1, p.get_int(
+                RaftServerConfigKeys.Replication.WINDOW_DEPTH_KEY,
+                RaftServerConfigKeys.Replication.WINDOW_DEPTH_DEFAULT))
+
+        @staticmethod
+        def reorder_buffer(p: RaftProperties) -> int:
+            return max(1, p.get_int(
+                RaftServerConfigKeys.Replication.REORDER_BUFFER_KEY,
+                RaftServerConfigKeys.Replication.REORDER_BUFFER_DEFAULT))
 
     class Engine:
         """TPU batched-quorum engine knobs (new; no reference analog — this

@@ -422,24 +422,41 @@ class QuorumEngine:
         append — routing them through mark_dirty would force the dirty-row
         refresh on every tick)."""
         with self._lock:
-            s = self.state
-            if flush_index < int(s.flush_index[slot]):
-                # regression (follower truncate): rare — take the refresh
-                # path, the device-side scatter-max would ignore a lower
-                # value
-                s.flush_index[slot] = flush_index
-                s.mark_dirty(slot)
-                self._wake_set()
-                return
+            self._on_flush_locked(slot, flush_index)
+
+    def on_flush_batch(self, rows) -> None:
+        """Packed flush intake (envelope sweep intake): ``rows`` is a
+        sequence of ``(slot, flush_index)`` rows — one multi-group append
+        frame's flush advances.  Applies exactly the per-row operations of
+        :meth:`on_flush`, in row order, under ONE intake-lock acquisition,
+        so a frame carrying N co-hosted groups' appends costs one lock
+        round-trip (and, via the wake dedupe, at most one tick wake)
+        instead of N."""
+        if not rows:
+            return
+        with self._lock:
+            for slot, flush_index in rows:
+                self._on_flush_locked(int(slot), int(flush_index))
+
+    def _on_flush_locked(self, slot: int, flush_index: int) -> None:
+        s = self.state
+        if flush_index < int(s.flush_index[slot]):
+            # regression (follower truncate): rare — take the refresh
+            # path, the device-side scatter-max would ignore a lower
+            # value
             s.flush_index[slot] = flush_index
-            u = self._slot_updates.get(slot)
-            if u is None:
-                self._slot_updates[slot] = [flush_index, _PACK_SENTINEL]
-            elif u[0] == _PACK_SENTINEL or flush_index > u[0]:
-                u[0] = flush_index
-            # A leader's own flush counts toward quorum: try the commit
-            # inline (single-peer groups commit on flush alone).
-            self._try_commit_inline(slot, flush_index)
+            s.mark_dirty(slot)
+            self._wake_set()
+            return
+        s.flush_index[slot] = flush_index
+        u = self._slot_updates.get(slot)
+        if u is None:
+            self._slot_updates[slot] = [flush_index, _PACK_SENTINEL]
+        elif u[0] == _PACK_SENTINEL or flush_index > u[0]:
+            u[0] = flush_index
+        # A leader's own flush counts toward quorum: try the commit
+        # inline (single-peer groups commit on flush alone).
+        self._try_commit_inline(slot, flush_index)
 
     def on_deadline(self, slot: int, deadline_ms: int) -> None:
         """(Re-)arm a follower election deadline; same packed-update route.

@@ -314,35 +314,73 @@ class AppendEnvelope:
     axis removes.  The envelope carries ordinary AppendEntriesRequests, so
     each group's semantics are exactly the unary path's; the receiver
     processes a group's items sequentially in order (RaftServer
-    _handle_append_envelope), which preserves per-group FIFO."""
+    _handle_append_envelope), which preserves per-group FIFO.
+
+    Sequenced append windows (round 9, raft.tpu.replication.window-depth):
+    with per-group frame pipelining a group's items MAY be split across
+    consecutive in-flight envelopes, so FIFO moves from the sender's busy
+    latch to the wire — ``lane`` names one (sender, destination,
+    loop-shard) lane instance (a fresh id per sender lifetime, so a
+    restarted sender never collides with its predecessor's sequence
+    space) and ``seq`` numbers the lane's frames from 0.  The receiver
+    processes a lane's frames strictly in sequence (out-of-order arrivals
+    briefly buffered, gaps rejected with a rewind hint — RaftServer's
+    lane intake).  ``seq < 0`` = unsequenced legacy frame, processed
+    immediately; a depth-1 sender emits only those, with bit-identical
+    wire bytes to the pre-window protocol."""
 
     items: tuple[AppendEntriesRequest, ...]
+    lane: int = 0
+    seq: int = -1
 
     def to_dict(self) -> dict:
-        return {"i": [r.to_dict() for r in self.items]}
+        d: dict = {"i": [r.to_dict() for r in self.items]}
+        if self.seq >= 0:
+            d["ln"] = self.lane
+            d["sq"] = self.seq
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "AppendEnvelope":
         return AppendEnvelope(
-            tuple(AppendEntriesRequest.from_dict(x) for x in d["i"]))
+            tuple(AppendEntriesRequest.from_dict(x) for x in d["i"]),
+            d.get("ln", 0), d.get("sq", -1))
+
+
+# AppendEnvelopeReply.status codes (sequenced lanes)
+ENV_OK = 0
+# the frame broke the lane's sequence (gap past the reorder buffer, a
+# duplicate, or a buffered wait that timed out): nothing was processed;
+# ``hint`` carries the sequence the receiver expects next — the sender
+# drops the lane's unacked frames and re-cuts on a fresh lane
+ENV_OUT_OF_SEQUENCE = 1
 
 
 @dataclasses.dataclass(frozen=True)
 class AppendEnvelopeReply:
     """Per-item replies; None where the peer failed that group (e.g. it does
-    not serve it) — the sender treats those as per-follower RPC errors."""
+    not serve it) — the sender treats those as per-follower RPC errors.
+    ``status != ENV_OK`` means the whole frame was refused unprocessed by
+    the receiver's lane intake (items is empty then)."""
 
     items: tuple[Optional[AppendEntriesReply], ...]
+    status: int = ENV_OK
+    hint: int = -1
 
     def to_dict(self) -> dict:
-        return {"i": [None if r is None else r.to_dict()
-                      for r in self.items]}
+        d: dict = {"i": [None if r is None else r.to_dict()
+                         for r in self.items]}
+        if self.status != ENV_OK:
+            d["st"] = self.status
+            d["hn"] = self.hint
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "AppendEnvelopeReply":
         return AppendEnvelopeReply(
             tuple(None if x is None else AppendEntriesReply.from_dict(x)
-                  for x in d["i"]))
+                  for x in d["i"]),
+            d.get("st", ENV_OK), d.get("hn", -1))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -604,11 +642,17 @@ def _encode_append_fast(msg) -> bytes:
         if type(msg) is AppendEnvelope:
             _pk_str(buf, "env_req")
             _pk_str(buf, "b")
-            buf.append(0x81)  # fixmap(1): i
+            sequenced = msg.seq >= 0
+            # fixmap(3): i ln sq (sequenced lane frame) / fixmap(1): i
+            # (legacy frame — byte-identical to the pre-window protocol)
+            buf.append(0x83 if sequenced else 0x81)
             _pk_str(buf, "i")
             _pk_arr(buf, len(msg.items))
             for req in msg.items:
                 _pk_append_request_body(buf, req)
+            if sequenced:
+                _pk_str(buf, "ln"); _pk_int(buf, msg.lane)  # noqa: E702
+                _pk_str(buf, "sq"); _pk_int(buf, msg.seq)  # noqa: E702
         else:
             _pk_str(buf, "append_req")
             _pk_str(buf, "b")

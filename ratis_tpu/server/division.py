@@ -411,8 +411,13 @@ class Division:
             self.server.engine.state.role[self.engine_slot] = role_code
             self.server.engine.state.mark_dirty(self.engine_slot)
 
-    def _engine_update_flush(self) -> None:
+    def _engine_update_flush(self, sink: Optional[list] = None) -> None:
         if self.engine_slot >= 0:
+            if sink is not None:
+                # envelope sweep intake: the caller feeds the whole
+                # frame's rows to QuorumEngine.on_flush_batch at once
+                sink.append((self.engine_slot, self.state.log.flush_index))
+                return
             # high-rate path (every append flushes): packed update
             self.server.engine.on_flush(self.engine_slot,
                                         self.state.log.flush_index)
@@ -967,13 +972,20 @@ class Division:
         receiver to defer contended items off its sequential sweep)."""
         return self._append_lock.locked()
 
-    async def handle_append_entries(self, req: AppendEntriesRequest
+    async def handle_append_entries(self, req: AppendEntriesRequest,
+                                    flush_sink: Optional[list] = None
                                     ) -> AppendEntriesReply:
+        """``flush_sink`` (envelope sweep intake): collect this append's
+        engine flush update as a packed ``(slot, flush_index)`` row instead
+        of a scalar ``on_flush`` call — the server feeds the whole frame's
+        rows to ``QuorumEngine.on_flush_batch`` in one pass."""
         with self.metrics.follower_append_timer.time():
             async with self._append_lock:
-                return await self._handle_append_entries_impl(req)
+                return await self._handle_append_entries_impl(req,
+                                                              flush_sink)
 
-    async def _handle_append_entries_impl(self, req: AppendEntriesRequest
+    async def _handle_append_entries_impl(self, req: AppendEntriesRequest,
+                                          flush_sink: Optional[list] = None
                                           ) -> AppendEntriesReply:
         await injection.execute(injection.APPEND_ENTRIES, self.member_id,
                                 req.header.requestor_id)
@@ -1020,7 +1032,7 @@ class Division:
                 if e.is_config():
                     state.apply_log_entry_configuration(e)
                     self.on_configuration_changed()
-            self._engine_update_flush()
+            self._engine_update_flush(flush_sink)
 
         # Follower commit: only up to the frontier THIS request verified
         # against the leader's log (Raft §5.3: min(leaderCommit, index of

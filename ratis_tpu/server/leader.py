@@ -172,7 +172,15 @@ class LogAppender:
         self._running = False
         self._epoch = 0        # bumped on window reset; stale replies ignored
         self._inflight = 0     # pipelined (non-heartbeat) requests outstanding
-        self._busy = False     # items in an in-flight envelope (FIFO latch)
+        # In-flight FRAMES carrying this group's items.  The bound is the
+        # sender's per-group window (raft.tpu.replication.window-depth):
+        # 1 = the classic one-envelope-at-a-time FIFO latch; >1 (sequenced
+        # lanes only) lets collect() cut the next batch from the
+        # speculative next-index while earlier frames are still on the
+        # wire, hiding the append round trip (GrpcLogAppender.java:343's
+        # sliding window, batched across groups).
+        self._frames = 0
+        self._frame_limit = max(1, getattr(self.sender, "group_window", 1))
         self._probe_due = False
         self._last_send_s = 0.0
         self._backoff_until = 0.0
@@ -315,18 +323,21 @@ class LogAppender:
         items are never split across two racing envelopes."""
         div = self.division
         f = self.follower
-        if not self._running or not div.is_leader() or self._busy:
+        if not self._running or not div.is_leader() \
+                or self._frames >= self._frame_limit:
             return 0
         now = time.monotonic()
         if now < self._backoff_until:
             return 0
         added = 0
-        # Latch BEFORE anything can be appended to out: if a later fill
-        # iteration raises, already-collected items still ship in this
-        # flush's envelope — without the latch a re-mark could split this
-        # group's items across two racing envelopes, breaking per-group
-        # FIFO.  Un-latch on the no-item path at the end.
-        self._busy = True
+        # Count the frame BEFORE anything can be appended to out: if a
+        # later fill iteration raises, already-collected items still ship
+        # in this flush's envelope — without the latch a re-mark could
+        # split this group's items across two racing envelopes.  At frame
+        # limit 1 that is the full FIFO guarantee; above it, racing frames
+        # are ordered by the sequenced-lane intake instead.  Un-count on
+        # the no-item path at the end.
+        self._frames += 1
         try:
             if self._probe_due:
                 probe = self._build_request(f.next_index, heartbeat=True)
@@ -364,7 +375,7 @@ class LogAppender:
                 out.append(OutItem(self, request, self._epoch, True))
         finally:
             if not added:
-                self._busy = False
+                self._frames -= 1
             else:
                 # any send re-arms the follower's election timer: a stale
                 # hibernate ack must not let the leader fall asleep without
@@ -372,11 +383,22 @@ class LogAppender:
                 self.hibernate_acked = False
         return added
 
+    def has_backlog(self) -> bool:
+        """Entries remain past the send cursor AND the frame window has
+        room: the sweep's drain pass uses this to keep cutting frames for
+        this group in the SAME pass (pipelining), instead of waiting out
+        the in-flight frame's round trip for the envelope_done re-mark."""
+        return (self._running and self._frames < self._frame_limit
+                and not self.follower.snapshot_in_progress
+                and self.division.is_leader()
+                and self.division.state.log.next_index
+                > self.follower.next_index)
+
     def envelope_done(self, remark: bool = True) -> None:
-        """The envelope carrying this appender's items completed (all its
-        replies/errors dispatched): release the FIFO latch and re-mark so
-        the next flush refills the window."""
-        self._busy = False
+        """An envelope carrying this appender's items completed (all its
+        replies/errors dispatched): release its frame-window slot and
+        re-mark so the next flush refills the window."""
+        self._frames = max(0, self._frames - 1)
         if remark and self._running and self.division.is_leader():
             self.sender.mark(self)
 
@@ -549,7 +571,7 @@ class LogAppender:
         div.on_follower_heartbeat_ack(f, ack_sink)
         log = div.state.log
         if (next_index < f.next_index and self._inflight == 0
-                and not self._busy):
+                and self._frames == 0):
             # Follower's log ends before our send cursor with nothing in
             # flight: it lost entries (restart) or our cursor is stale.
             # Send a full probe so the INCONSISTENCY path decides with
@@ -591,6 +613,13 @@ class LogAppender:
                 # gRPC stream dispatch should keep this at ~0 under load
                 m = div.server.replication.metrics
                 m["rewinds"] = m.get("rewinds", 0) + 1
+                if self._frames > 1 or self._inflight > 0:
+                    # windowed rewind: >0 unacked pipelined frames beyond
+                    # this one are being dropped (epoch bump) and the lane
+                    # re-cuts from the rewound next-index — not a full
+                    # per-destination reset
+                    m["windowed_rewinds"] = \
+                        m.get("windowed_rewinds", 0) + 1
                 hint = min(reply.next_index,
                            max(request.previous.index if request.previous
                                else 0, 0))

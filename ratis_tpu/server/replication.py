@@ -14,29 +14,49 @@ coalescing), or into a concurrent burst of unary RPCs when coalescing is
 disabled (the reference's per-group cost shape, kept as the benchmark
 baseline mode).
 
-Ordering: per-group FIFO holds end to end because (a) an appender
-contributes items to at most one in-flight envelope at a time (the
-``collect``/``envelope_done`` busy latch), (b) envelopes carry items in
-collect order, and (c) the receiver (RaftServer._handle_append_envelope)
-processes one group's items sequentially in order.  Reordering across those
+Ordering: per-group FIFO holds end to end because (a) a group contributes
+items to a bounded window of consecutive in-flight frames
+(``raft.tpu.replication.window-depth``; depth 1 degenerates to the
+one-envelope-at-a-time busy latch), (b) envelopes carry items in collect
+order and sequenced frames carry monotonically numbered (lane, seq) pairs,
+and (c) the receiver (RaftServer._handle_append_envelope) processes a
+lane's frames strictly in sequence and one group's items sequentially in
+envelope order.  With depth > 1 the round trip is PIPELINED: the next
+frame is cut from the speculatively-advanced next-index while earlier
+frames are still in flight, so a commit no longer pays a full RTT of dead
+time per group (reference: GrpcLogAppender.java:343-381's per-follower
+sliding window, here batched across groups).  Reordering across those
 guarantees (e.g. unary mode over a reordering transport) at worst costs a
-spurious INCONSISTENCY + window reset — never safety, because match only
-advances from request-capped SUCCESS confirmations.
+spurious INCONSISTENCY + windowed rewind — never safety, because match
+only advances from request-capped SUCCESS confirmations.
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
+import os
 from typing import NamedTuple, Optional
 
 from ratis_tpu.metrics.hops import hop
 from ratis_tpu.protocol.exceptions import TimeoutIOException
 from ratis_tpu.protocol.ids import RaftPeerId
-from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope,
-                                        AppendResult)
+from ratis_tpu.protocol.raftrpc import (ENV_OK, AppendEntriesRequest,
+                                        AppendEnvelope, AppendResult)
 
 LOG = logging.getLogger(__name__)
+
+# Lane ids are unique per PeerSender LIFETIME (a restarted/recreated sender
+# never reuses its predecessor's sequence space at the receiver) and across
+# co-hosted processes dialing the same peer under one requestor id after a
+# restart (the pid component).
+_LANE_IDS = itertools.count(1)
+_LANE_BASE = (os.getpid() & 0x7FFFF) << 32
+
+
+def _new_lane_id() -> int:
+    return _LANE_BASE | next(_LANE_IDS)
 
 
 class _LoopSweep:
@@ -69,21 +89,51 @@ class PeerSender:
     A flush collects from all marked appenders (round-robin in mark order,
     bounded by the envelope byte budget) and ships one envelope; up to
     ``inflight_cap`` envelopes may be in flight so one slow envelope never
-    head-of-line-blocks other groups' batches.  While an envelope is in
-    flight its appenders are latched busy, so a group's entries are never
-    split across two racing envelopes.
+    head-of-line-blocks other groups' batches.  With
+    ``raft.tpu.replication.window-depth`` > 1 (sweep mode + coalescing)
+    frames are SEQUENCED on a per-sender lane and a group may ride up to
+    depth consecutive in-flight frames — per-group FIFO is enforced by the
+    receiver's in-sequence lane intake instead of the busy latch.  Depth 1
+    keeps the latch exactly: a group's entries are never split across two
+    racing envelopes and frames go out unsequenced (the legacy wire
+    shape).
     """
 
     def __init__(self, server, to: RaftPeerId, *, coalescing: bool,
                  inflight_cap: int, envelope_byte_limit: int,
                  metrics: Optional[dict] = None, sweep: bool = False,
-                 scheduler: "Optional[ReplicationScheduler]" = None):
+                 scheduler: "Optional[ReplicationScheduler]" = None,
+                 window_depth: int = 1):
         self.server = server
         self.to = to
         self.coalescing = coalescing
         self.envelope_byte_limit = envelope_byte_limit
+        self.inflight_cap = max(1, inflight_cap)
+        # Per-group frame window: only meaningful on the sequenced frame
+        # path — sweep + coalescing.  Legacy (sweep=0) and unary modes pin
+        # the effective depth at 1 so their paths stay bit-exact.
+        self.window_depth = max(1, window_depth)
+        self.sequenced = coalescing and sweep and self.window_depth > 1
+        self.group_window = self.window_depth if self.sequenced else 1
+        if self.sequenced:
+            # The lane must hold enough envelope slots for the per-group
+            # window to actually fill: with the slot cap at the legacy 4,
+            # the depth knob never engages (measured: slots pinned full,
+            # occupancy 1.0, zero throughput delta across depths — the
+            # envelope window was the binding pipeline, docs/perf.md
+            # round 9).  Depth 1 keeps the exact legacy cap.
+            self.inflight_cap = min(64,
+                                    self.inflight_cap * self.window_depth)
+        # lane identity + next frame sequence (sequenced mode): reset to a
+        # FRESH lane on any sequenced send failure or receiver reject, so
+        # the receiver never waits out a gap that will not fill
+        self._lane = _new_lane_id()
+        self._seq = 0
+        self._frames_out = 0  # envelopes currently in flight (all modes)
         self.metrics = metrics if metrics is not None else {
-            "envelopes": 0, "items": 0, "rewinds": 0}
+            "envelopes": 0, "items": 0, "rewinds": 0,
+            "windowed_rewinds": 0, "lane_rejects": 0, "lane_resets": 0,
+            "win_hwm": 0, "seq_frames": 0}
         self._dirty: dict[object, None] = {}  # insertion-ordered appender set
         self.refs: set = set()  # registered appenders (scheduler-managed)
         # the loop this sender (and every appender feeding it) lives on:
@@ -101,9 +151,9 @@ class PeerSender:
         self._task: Optional[asyncio.Task] = None
         if sweep:
             self._slots = None
-            self._slots_free = max(1, inflight_cap)
+            self._slots_free = self.inflight_cap
         else:
-            self._slots = asyncio.Semaphore(max(1, inflight_cap))
+            self._slots = asyncio.Semaphore(self.inflight_cap)
             self._slots_free = 0
         self._running = True
         self._inflight_tasks: set[asyncio.Task] = set()
@@ -129,6 +179,41 @@ class PeerSender:
     def unmark(self, appender) -> None:
         self._dirty.pop(appender, None)
 
+    # -- sequenced lane bookkeeping -------------------------------------------
+
+    @property
+    def frames_in_flight(self) -> int:
+        """Envelopes currently awaiting their reply (window-state gauge)."""
+        return self._frames_out
+
+    def _next_frame(self) -> tuple[int, int]:
+        """(lane, seq) for the envelope being dispatched — assigned in
+        collect order on this sender's loop, so lane sequence == intended
+        send order; also tracks the in-flight frame count and its
+        high-water mark (the bench's window-occupancy artifact)."""
+        self._frames_out += 1
+        m = self.metrics
+        if self._frames_out > m.get("win_hwm", 0):
+            m["win_hwm"] = self._frames_out
+        if not self.sequenced:
+            return 0, -1
+        m["seq_frames"] = m.get("seq_frames", 0) + 1
+        seq = self._seq
+        self._seq += 1
+        return self._lane, seq
+
+    def _reset_lane(self) -> None:
+        """A sequenced frame failed to reach (or was refused by) the
+        receiver: its lane now has a hole that will never fill, so every
+        later frame of the lane would be rejected.  Re-cut on a FRESH lane
+        — the receiver starts a new in-sequence intake at seq 0 and the
+        dead lane's state ages out of its bounded table."""
+        if self.sequenced:
+            self._lane = _new_lane_id()
+            self._seq = 0
+            self.metrics["lane_resets"] = \
+                self.metrics.get("lane_resets", 0) + 1
+
     # -- sweep mode: scheduler-driven drain pass ------------------------------
 
     def sweep_collect(self) -> None:
@@ -145,7 +230,15 @@ class PeerSender:
                 a = next(iter(self._dirty))
                 del self._dirty[a]
                 try:
-                    budget -= a.collect(items, budget)
+                    got = a.collect(items, budget)
+                    budget -= got
+                    if got and self.sequenced and a.has_backlog():
+                        # the byte budget cut this group's fill short and
+                        # its frame window still has room: keep it due so
+                        # THIS drain pass cuts its next frame too (the
+                        # pipelined fill; gated on progress, so a
+                        # backoff/prefault collect can never spin)
+                        self._dirty[a] = None
                 except Exception:
                     LOG.exception("%s->%s collect failed for %s",
                                   server.peer_id, self.to, a)
@@ -155,7 +248,8 @@ class PeerSender:
             self.metrics["items"] += len(items)
             if self.coalescing:
                 self._slots_free -= 1
-                t = asyncio.create_task(self._send(items))
+                lane, seq = self._next_frame()
+                t = asyncio.create_task(self._send(items, lane, seq))
                 self._inflight_tasks.add(t)
                 t.add_done_callback(self._inflight_tasks.discard)
             else:
@@ -169,6 +263,7 @@ class PeerSender:
                     t.add_done_callback(self._inflight_tasks.discard)
 
     def _release_slot(self) -> None:
+        self._frames_out = max(0, self._frames_out - 1)
         if self.sweep:
             self._slots_free += 1
             if self._dirty and self._running:
@@ -211,7 +306,8 @@ class PeerSender:
             self.metrics["envelopes"] += 1
             self.metrics["items"] += len(items)
             if self.coalescing:
-                t = asyncio.create_task(self._send(items))
+                lane, seq = self._next_frame()
+                t = asyncio.create_task(self._send(items, lane, seq))
                 self._inflight_tasks.add(t)
                 t.add_done_callback(self._inflight_tasks.discard)
             else:
@@ -249,7 +345,8 @@ class PeerSender:
             if not self.sweep:
                 self._wake.set()
 
-    async def _send(self, items: list[OutItem]) -> None:
+    async def _send(self, items: list[OutItem], lane: int = 0,
+                    seq: int = -1) -> None:
         server = self.server
         replies: list = []
         error: Optional[Exception] = None
@@ -265,7 +362,30 @@ class PeerSender:
         # releases the envelope slot and the appenders' busy latch.
         try:
             try:
-                if len(items) > 1:
+                if seq >= 0:
+                    # sequenced lane frame: even a single-item flush must
+                    # ride the lane — the group may have another frame in
+                    # flight, and only the receiver's in-sequence intake
+                    # keeps the two ordered
+                    env = AppendEnvelope(
+                        tuple(it.request for it in items), lane, seq)
+                    reply = await server.send_server_rpc(self.to, env)
+                    if reply.status != ENV_OK:
+                        # the receiver refused the frame unprocessed
+                        # (sequence hole / stale duplicate): drop the
+                        # lane's unacked frames, re-cut fresh
+                        self.metrics["lane_rejects"] = \
+                            self.metrics.get("lane_rejects", 0) + 1
+                        if lane == self._lane:
+                            self._reset_lane()
+                        raise TimeoutIOException(
+                            f"{self.to} refused lane frame seq={seq} "
+                            f"(expects {reply.hint})")
+                    replies = list(reply.items)
+                    if len(replies) != len(items):
+                        raise TimeoutIOException(
+                            "envelope reply length mismatch")
+                elif len(items) > 1:
                     env = AppendEnvelope(tuple(it.request for it in items))
                     reply = await server.send_server_rpc(self.to, env)
                     replies = list(reply.items)
@@ -280,6 +400,10 @@ class PeerSender:
                 raise
             except Exception as e:
                 error = e
+                if seq >= 0 and lane == self._lane:
+                    # the frame may never have reached the receiver: later
+                    # frames of this lane would stall on the hole — re-cut
+                    self._reset_lane()
             for i, it in enumerate(items):
                 rep = error if error is not None else replies[i]
                 try:
@@ -341,11 +465,20 @@ class ReplicationScheduler:
     (created lazily; peers are few even when groups are many)."""
 
     def __init__(self, server, *, coalescing: bool, inflight_cap: int,
-                 envelope_byte_limit: int, sweep: bool = False):
+                 envelope_byte_limit: int, sweep: bool = False,
+                 window_depth: int = 1):
         self.server = server
         self.coalescing = coalescing
-        self.inflight_cap = inflight_cap
+        self.inflight_cap = max(1, inflight_cap)
         self.envelope_byte_limit = envelope_byte_limit
+        # Sequenced append-window pipelining
+        # (raft.tpu.replication.window-depth): frames-per-group window on
+        # every sender; 1 = the latched stop-and-wait-per-group protocol
+        self.window_depth = max(1, window_depth)
+        # hook: called once per NEW destination (server registers its
+        # per-destination window gauges through this)
+        self.on_destination = None
+        self._known_dests: set[RaftPeerId] = set()
         # Cross-group append sweeps (raft.tpu.replication.sweep): marks
         # arm ONE drain pass per (loop, burst) that collects due
         # AppendEntries across every destination's dirty appenders on
@@ -365,8 +498,15 @@ class ReplicationScheduler:
         # shared across senders: folding evidence for tests/benchmarks;
         # "rewinds" counts INCONSISTENCY-triggered window resets (the
         # reorder churn the keyed-FIFO gRPC dispatch exists to prevent —
-        # ADVICE r5; incremented by LogAppender._on_reply)
-        self.metrics = {"envelopes": 0, "items": 0, "rewinds": 0}
+        # ADVICE r5; incremented by LogAppender._on_reply);
+        # "windowed_rewinds" the subset taken while >1 frame of the group
+        # was in flight (the pipelined rewind path); "lane_rejects" /
+        # "lane_resets" the sequenced-lane recovery events; "win_hwm" the
+        # frames-in-flight high-water mark across senders (bench window
+        # occupancy = win_hwm / inflight_cap)
+        self.metrics = {"envelopes": 0, "items": 0, "rewinds": 0,
+                        "windowed_rewinds": 0, "lane_rejects": 0,
+                        "lane_resets": 0, "win_hwm": 0, "seq_frames": 0}
 
     @staticmethod
     def codec_stats() -> dict:
@@ -394,9 +534,44 @@ class ReplicationScheduler:
                            inflight_cap=self.inflight_cap,
                            envelope_byte_limit=self.envelope_byte_limit,
                            metrics=self.metrics, sweep=self.sweep,
-                           scheduler=self)
+                           scheduler=self, window_depth=self.window_depth)
             self._senders[key] = s
+            if to not in self._known_dests:
+                self._known_dests.add(to)
+                if self.on_destination is not None:
+                    try:
+                        self.on_destination(to)
+                    except Exception:
+                        LOG.exception("on_destination hook failed for %s",
+                                      to)
         return s
+
+    # -- window state (gauges / watchdog) -------------------------------------
+
+    @property
+    def lane_slots(self) -> int:
+        """Envelope slots per (destination, loop-shard) lane — the
+        configured inflight cap, scaled by window-depth on the sequenced
+        path (matches PeerSender's own computation; the bench's
+        window-occupancy denominator)."""
+        if self.coalescing and self.sweep and self.window_depth > 1:
+            return min(64, self.inflight_cap * self.window_depth)
+        return self.inflight_cap
+
+    def frames_in_flight(self, to: Optional[RaftPeerId] = None) -> int:
+        """Envelopes in flight toward ``to`` (all destinations when None),
+        summed across loop-shard senders."""
+        return sum(s.frames_in_flight for (d, _), s in self._senders.items()
+                   if to is None or d == to)
+
+    def window_occupancy(self, to: Optional[RaftPeerId] = None) -> float:
+        """frames-in-flight / envelope-slot capacity toward ``to``."""
+        senders = [s for (d, _), s in self._senders.items()
+                   if to is None or d == to]
+        cap = sum(s.inflight_cap for s in senders)
+        if not cap:
+            return 0.0
+        return round(sum(s.frames_in_flight for s in senders) / cap, 4)
 
     # -- sweep mode: one drain pass per (loop, burst) -------------------------
 

@@ -230,6 +230,84 @@ class BulkHeartbeatService:
         self._pending.clear()
 
 
+class _LaneGap(Exception):
+    """A buffered frame's lane gap never filled (its predecessor frame was
+    lost): reject the frame with a rewind hint instead of processing it."""
+
+
+class _LaneIntake:
+    """Follower-side state of ONE sequenced append lane (RaftServer lane
+    intake): frames process strictly in sequence — ``next_process`` only
+    advances when a frame's processing COMPLETES, so a group's items in
+    frame k+1 can never reach its division before frame k's (the ordering
+    the sender's busy latch used to provide).  Out-of-order arrivals park
+    on per-seq futures.  The ``busy`` flag is an OWNERSHIP token: a
+    completing frame hands it directly to its parked successor
+    (``pass_on`` wakes the future with busy left True), so the lane is
+    never observably idle between back-to-back frames — which is also
+    what keeps the gap timer honest: a genuine sequence gap (the frame we
+    need next never arrived while the lane is idle) is detected by a
+    one-shot timer and rejects every parked frame with a rewind hint."""
+
+    # how long a parked frame waits for a missing predecessor before the
+    # lane rejects it (a merely-slow predecessor never trips this — the
+    # timer only fires when the needed frame never ARRIVED)
+    GAP_WAIT_S = 1.0
+
+    __slots__ = ("next_process", "next_arrival", "busy", "waiting",
+                 "gap_timer", "last_used")
+
+    def __init__(self, first_seq: int):
+        # adopt the first observed sequence: a receiver restart (or lane
+        # eviction) must not reject a healthy lane forever
+        self.next_process = first_seq
+        self.next_arrival = first_seq
+        self.busy = False
+        self.waiting: dict[int, asyncio.Future] = {}
+        self.gap_timer = None
+        self.last_used = 0.0
+
+    @property
+    def gapped(self) -> bool:
+        """Frames are parked but the one we need next never arrived."""
+        return (not self.busy and bool(self.waiting)
+                and self.next_process not in self.waiting)
+
+    def arm_gap_timer(self, loop: asyncio.AbstractEventLoop) -> None:
+        if self.gap_timer is None and self.gapped:
+            self.gap_timer = loop.call_later(self.GAP_WAIT_S,
+                                             self._on_gap_timer)
+
+    def _on_gap_timer(self) -> None:
+        self.gap_timer = None
+        if not self.gapped:
+            return
+        for fut in self.waiting.values():
+            if not fut.done():
+                fut.set_exception(_LaneGap())
+        self.waiting.clear()
+
+    def pass_on(self, loop: asyncio.AbstractEventLoop) -> None:
+        """Release lane ownership: hand it to the parked ``next_process``
+        frame (busy stays True across the transfer), or mark the lane
+        idle and (re-)arm gap detection if later frames wait on a hole."""
+        fut = self.waiting.pop(self.next_process, None)
+        if fut is not None and not fut.done():
+            fut.set_result(None)  # ownership transferred
+        else:
+            self.busy = False
+            self.arm_gap_timer(loop)
+
+    def close(self) -> None:
+        if self.gap_timer is not None:
+            self.gap_timer.cancel()
+            self.gap_timer = None
+        for fut in self.waiting.values():
+            if not fut.done():
+                fut.set_exception(_LaneGap())
+        self.waiting.clear()
+
+
 class RaftServer:
     def __init__(self, peer_id: RaftPeerId, address: str,
                  state_machine_registry: StateMachineRegistry,
@@ -313,7 +391,20 @@ class RaftServer:
             coalescing=appender_keys.coalescing_enabled(p),
             inflight_cap=appender_keys.envelope_inflight(p),
             envelope_byte_limit=appender_keys.envelope_byte_limit(p),
-            sweep=self.replication_sweep)
+            sweep=self.replication_sweep,
+            window_depth=repl_keys.window_depth(p))
+        # Follower-side sequenced lane intake
+        # (raft.tpu.replication.window-depth > 1 senders): lane id ->
+        # _LaneIntake processing that lane's frames strictly in sequence.
+        # Bounded: dead lanes (sender restarts/re-cuts) age out by LRU.
+        self._lanes: dict = {}
+        self.reorder_buffer = repl_keys.reorder_buffer(p)
+        self.lane_metrics = {"ooo_buffered": 0, "lane_rejects": 0,
+                             "lane_frames": 0}
+        # cross-frame per-group order chains (sequenced frames only):
+        # group id -> the tail frame's completion future, each entry only
+        # ever touched from the group's owning loop
+        self._group_chains: dict = {}
         # scheduling-hops-per-commit: the fan-out collapse as a standing
         # measured artifact (metrics/hops.py; per-site gauges + the
         # hops-per-commit ratio on this server's registry)
@@ -328,6 +419,29 @@ class RaftServer:
             plane.gauge(labeled("schedulingHops", site=site),
                         lambda s=site: hops_mod.snapshot()[s])
         plane.gauge("replyHopsPerCommit", self.reply_hops_per_commit)
+        # Window state (round 9): sender-side rewind/lane counters +
+        # follower-side lane-intake counters, plus per-destination
+        # frames-in-flight / occupancy gauges registered as destinations
+        # appear (peers are few even when groups are many).
+        rm = self.replication.metrics
+        plane.gauge("windowDepth",
+                    lambda: self.replication.window_depth)
+        plane.gauge("windowRewinds",
+                    lambda: rm.get("windowed_rewinds", 0))
+        plane.gauge("windowLaneResets", lambda: rm.get("lane_resets", 0))
+        plane.gauge("windowLaneRejects", lambda: rm.get("lane_rejects", 0))
+        plane.gauge("laneOutOfOrderBuffered",
+                    lambda: self.lane_metrics["ooo_buffered"])
+        plane.gauge("laneIntakeRejects",
+                    lambda: self.lane_metrics["lane_rejects"])
+
+        def _register_window_gauges(dest) -> None:
+            plane.gauge(labeled("windowFramesInFlight", dest=str(dest)),
+                        lambda d=dest: self.replication.frames_in_flight(d))
+            plane.gauge(labeled("windowOccupancy", dest=str(dest)),
+                        lambda d=dest: self.replication.window_occupancy(d))
+
+        self.replication.on_destination = _register_window_gauges
         # single source of truth for the heartbeat cadence (LeaderContext
         # and the sweep must agree, or heartbeat gaps silently grow)
         self.heartbeat_interval_s = \
@@ -506,6 +620,9 @@ class RaftServer:
                 await self.shards.run_on(sched.shard, sched.service.close())
         self._hb_shards.clear()
         await self.replication.close()
+        for st in self._lanes.values():
+            st.close()  # cancel gap timers, release any parked frames
+        self._lanes.clear()
         from ratis_tpu.metrics.registry import MetricRegistries
         MetricRegistries.global_registries().remove(self._plane_info)
         await self.engine.close()
@@ -767,47 +884,214 @@ class RaftServer:
             return await div.handle_start_leader_election(msg)
         raise RaftException(f"unknown server rpc {type(msg).__name__}")
 
+    # bounded lane table: dead lanes (sender restarts / lane re-cuts) are
+    # LRU-evicted; live lanes (parked or processing frames) are never
+    # evicted mid-flight
+    _LANE_TABLE_MAX = 512
+    # hard per-lane cap on IN-ORDER frames queued behind a busy
+    # predecessor (memory bound; matches the sender-side lane-slot
+    # ceiling, so a healthy sender never hits it)
+    _LANE_QUEUE_MAX = 64
+
     async def _handle_append_envelope(self, env: AppendEnvelope
                                       ) -> AppendEnvelopeReply:
-        """Fan an append envelope (coalesced data batches and/or heartbeats)
-        out to its divisions.  Groups are independent, so distinct groups are
-        handled concurrently; one group's items are handled sequentially in
-        envelope order, which — with the sender's one-envelope-per-appender
-        latch — preserves per-group FIFO end to end.  A group this server
-        doesn't host yields None — a per-group error, not an envelope
-        failure."""
+        """Follower intake of a multi-group append frame.  Unsequenced
+        frames (seq < 0 — depth-1 senders, the legacy protocol) apply
+        immediately; sequenced lane frames are sequence-checked first and
+        process strictly in lane order (out-of-order arrivals briefly
+        buffered, gaps rejected with a rewind hint) — the receiver half of
+        the append-window pipeline."""
+        if env.seq < 0:
+            return await self._apply_append_envelope(env)
+        return await self._handle_sequenced_envelope(env)
+
+    async def _handle_sequenced_envelope(self, env: AppendEnvelope
+                                         ) -> AppendEnvelopeReply:
+        from ratis_tpu.protocol.raftrpc import ENV_OUT_OF_SEQUENCE
+        loop = asyncio.get_running_loop()
+        # lane ids are unique per sender lifetime; the requestor id keys
+        # co-hosted processes apart even across pid reuse
+        requestor = (env.items[0].header.requestor_id if env.items
+                     else None)
+        key = (requestor, env.lane)
+        st = self._lanes.get(key)
+        if st is None:
+            st = _LaneIntake(env.seq)
+            self._lanes[key] = st
+            if len(self._lanes) > self._LANE_TABLE_MAX:
+                idle = [(s.last_used, k) for k, s in self._lanes.items()
+                        if k != key and not s.busy and not s.waiting]
+                if idle:
+                    victim = self._lanes.pop(min(idle)[1], None)
+                    if victim is not None:
+                        victim.close()
+        st.last_used = loop.time()
+
+        def reject() -> AppendEnvelopeReply:
+            self.lane_metrics["lane_rejects"] += 1
+            return AppendEnvelopeReply((), status=ENV_OUT_OF_SEQUENCE,
+                                       hint=st.next_process)
+
+        if env.seq < st.next_process or env.seq in st.waiting \
+                or (st.busy and env.seq == st.next_process):
+            return reject()  # duplicate / stale frame: never re-process
+        if env.seq > st.next_arrival:
+            self.lane_metrics["ooo_buffered"] += 1  # genuine reorder
+        st.next_arrival = max(st.next_arrival, env.seq + 1)
+        if st.busy or env.seq != st.next_process:
+            # park until our turn.  IN-ORDER frames queued behind a busy
+            # predecessor are ordinary pipelining (bounded only by the
+            # hard lane-queue cap — the sender's slot window keeps this
+            # small); frames parked past a sequence HOLE (arrived
+            # unprocessed frames don't account for every seq below us)
+            # are genuine reorders, bounded by the reorder buffer, and a
+            # hole that never fills trips the lane's gap timer and
+            # rejects every parked frame
+            arrived = len(st.waiting) + (1 if st.busy else 0)
+            hole = arrived < env.seq - st.next_process
+            limit = (self.reorder_buffer if hole
+                     else self._LANE_QUEUE_MAX)
+            if len(st.waiting) >= limit:
+                return reject()
+            fut = loop.create_future()
+            st.waiting[env.seq] = fut
+            st.arm_gap_timer(loop)
+            try:
+                # a normal wake IS the ownership hand-off (busy stays
+                # True across the transfer — see _LaneIntake.pass_on)
+                await fut
+            except _LaneGap:
+                return reject()
+            except asyncio.CancelledError:
+                if st.waiting.get(env.seq) is fut:
+                    st.waiting.pop(env.seq, None)
+                elif fut.done() and not fut.cancelled():
+                    # ownership had just been handed to us: pass it on so
+                    # the lane is not wedged by our cancellation
+                    st.pass_on(loop)
+                raise
+        else:
+            st.busy = True
+        self.lane_metrics["lane_frames"] += 1
+        try:
+            # ADMISSION is the synchronous part: the frame's group runs
+            # are created (and their per-group order chains registered)
+            # before the lane admits the next frame — so cross-frame
+            # per-group FIFO is fixed here, and frames then PROCESS
+            # concurrently (distinct groups never wait on each other's
+            # frames; the legacy envelope concurrency, kept)
+            pending = self._start_append_envelope(env)
+        finally:
+            st.next_process = env.seq + 1
+            st.last_used = loop.time()
+            st.pass_on(loop)
+        return await pending
+
+    async def _apply_append_envelope(self, env: AppendEnvelope
+                                     ) -> AppendEnvelopeReply:
+        return await self._start_append_envelope(env)
+
+    def _start_append_envelope(self, env: AppendEnvelope):
+        """Sweep intake: fan the frame out to its divisions; returns the
+        awaitable producing the frame's batched ack reply.  Groups are
+        independent, so distinct groups are handled concurrently; one
+        group's items are handled sequentially in envelope order, and —
+        for sequenced frames, whose groups MAY span consecutive frames —
+        a per-group completion chain orders frame k+1's run for a group
+        after frame k's (registered synchronously in admission order, on
+        the group's owning loop).  A group this server doesn't host
+        yields None — a per-group error, not an envelope failure.  In
+        sweep mode every item's engine flush update is collected and
+        enters the engine as ONE batched intake after the whole frame has
+        appended (one intake-lock round-trip per frame instead of one per
+        item)."""
         items = env.items
+        chained = env.seq >= 0
         results: list = [None] * len(items)
+        # per-item flush rows (index-disjoint, so cross-shard writes are
+        # safe); batched into one engine intake below
+        flush_rows: Optional[list] = ([None] * len(items)
+                                      if self.replication_sweep else None)
         by_group: dict = {}
         for i, req in enumerate(items):
             by_group.setdefault(req.header.group_id, []).append(i)
 
-        async def run_group(idxs):
-            for i in idxs:
-                try:
-                    div = self.get_division(items[i].header.group_id)
-                    results[i] = await div.handle_append_entries(items[i])
-                except Exception:
-                    results[i] = None
+        def register_chain(gid):
+            """Per-group cross-frame order link; called synchronously on
+            the group's owning loop, in frame admission order."""
+            if not chained:
+                return None, None
+            prev = self._group_chains.get(gid)
+            fut = asyncio.get_running_loop().create_future()
+            self._group_chains[gid] = fut
+            return prev, fut
+
+        async def run_group(gid, idxs, prev, fut):
+            try:
+                if prev is not None:
+                    try:
+                        await prev  # frame k's run for this group
+                    except Exception:
+                        pass
+                for i in idxs:
+                    try:
+                        div = self.get_division(
+                            items[i].header.group_id)
+                        if flush_rows is None:
+                            results[i] = await div.handle_append_entries(
+                                items[i])
+                        else:
+                            rows: list = []
+                            flush_rows[i] = rows
+                            results[i] = await div.handle_append_entries(
+                                items[i], flush_sink=rows)
+                    except Exception:
+                        results[i] = None
+            finally:
+                if fut is not None:
+                    if not fut.done():
+                        fut.set_result(None)
+                    if self._group_chains.get(gid) is fut:
+                        del self._group_chains[gid]
 
         if self.shards is None:
-            await asyncio.gather(*(run_group(ix) for ix in by_group.values()))
+            # chains registered NOW (synchronously, in admission order);
+            # gather creates the group tasks in the same breath
+            aw = asyncio.gather(
+                *(run_group(gid, ix, *register_chain(gid))
+                  for gid, ix in by_group.items()))
+        else:
+            # sharded: each group's ordered run executes on its owning
+            # loop; groups on one shard still run concurrently there
+            # (gather inside the shard hop), shards run in parallel.  The
+            # flat results list is index-disjoint across groups, so
+            # cross-thread writes are safe.  Chain registration happens
+            # as the shard coroutine's FIRST synchronous step: shard
+            # submissions preserve admission order per loop
+            # (run_coroutine_threadsafe is FIFO), so registration order
+            # equals admission order there too.
+            by_shard: dict[int, list] = {}
+            for gid, idxs in by_group.items():
+                by_shard.setdefault(self.shard_of_group(gid),
+                                    []).append((gid, idxs))
+
+            async def run_shard(group_runs):
+                await asyncio.gather(
+                    *(run_group(gid, ix, *register_chain(gid))
+                      for gid, ix in group_runs))
+
+            aw = asyncio.gather(*(self.shards.run_on(k, run_shard(v))
+                                  for k, v in by_shard.items()))
+
+        async def finish() -> AppendEnvelopeReply:
+            await aw
+            if flush_rows is not None:
+                rows = [r for sub in flush_rows if sub for r in sub]
+                if rows:
+                    self.engine.on_flush_batch(rows)
             return AppendEnvelopeReply(tuple(results))
 
-        # sharded: each group's ordered run executes on its owning loop;
-        # groups on one shard still run concurrently there (gather inside
-        # the shard hop), shards run in parallel.  The flat results list is
-        # index-disjoint across groups, so cross-thread writes are safe.
-        by_shard: dict[int, list] = {}
-        for gid, idxs in by_group.items():
-            by_shard.setdefault(self.shard_of_group(gid), []).append(idxs)
-
-        async def run_shard(group_runs):
-            await asyncio.gather(*(run_group(ix) for ix in group_runs))
-
-        await asyncio.gather(*(self.shards.run_on(k, run_shard(v))
-                               for k, v in by_shard.items()))
-        return AppendEnvelopeReply(tuple(results))
+        return finish()
 
     async def _handle_bulk_heartbeat(self, msg):
         """Follower side of the compact multi-group heartbeat: one small

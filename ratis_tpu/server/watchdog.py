@@ -16,6 +16,11 @@ failure shapes the perf rounds have actually hit:
 - **follower-lag**: a follower's match index more than a threshold of
   entries behind its leader's commit — a snapshot-install candidate or a
   silently failing appender.
+- **stuck-lane**: a replication sender's append window stays FULL
+  (every envelope slot in flight) across consecutive samples while the
+  engine's commit waterline is flat — the shape of a wedged append
+  round trip (frozen peer, lost replies, a lane gap that never
+  recovers) under the round-9 pipelined window.
 
 Events land in a bounded ring journal (never unbounded memory, oldest
 drop first) served at ``GET /events`` by the metrics endpoint and
@@ -42,7 +47,9 @@ LOG = logging.getLogger(__name__)
 KIND_COMMIT_STALL = "commit-stall"
 KIND_ELECTION_CHURN = "election-churn"
 KIND_FOLLOWER_LAG = "follower-lag"
-KINDS = (KIND_COMMIT_STALL, KIND_ELECTION_CHURN, KIND_FOLLOWER_LAG)
+KIND_STUCK_LANE = "stuck-lane"
+KINDS = (KIND_COMMIT_STALL, KIND_ELECTION_CHURN, KIND_FOLLOWER_LAG,
+         KIND_STUCK_LANE)
 
 # consecutive flat samples (with pending requests) before a commit-stall
 # event is journaled: one flat interval is ordinary queueing, two is not
@@ -78,6 +85,11 @@ class StallWatchdog:
         self._stalled: set = set()
         self._lagging: set = set()
         self._last_elections = None  # server-wide election activity count
+        # stuck-lane detection: (destination, sender id) -> consecutive
+        # window-full-while-commits-flat samples; one event per episode
+        self._lane_full: dict = {}
+        self._lane_stuck: set = set()
+        self._last_commits = None  # engine commit_advances at last sample
         info = MetricRegistryInfo(prefix=str(server.peer_id),
                                   application="ratis", component="server",
                                   name="watchdog")
@@ -201,3 +213,39 @@ class StallWatchdog:
                           f"{self.interval_s:.1f}s "
                           f"(threshold {self.churn_threshold})")
         self._last_elections = elections
+        self._check_stuck_lanes()
+
+    def _check_stuck_lanes(self) -> None:
+        """Stuck-lane detection (round-9 append windows): a sender whose
+        envelope window stays FULL across consecutive samples while the
+        engine's commit waterline is flat is a wedged round trip — under
+        pipelining a healthy full window drains within one RTT, so full +
+        no commit progress twice in a row is an anomaly, not load."""
+        commits = int(self.server.engine.metrics.get("commit_advances", 0))
+        flat = (self._last_commits is not None
+                and commits == self._last_commits)
+        self._last_commits = commits
+        live = set()
+        for (dest, _loop_key), sender in \
+                list(self.server.replication._senders.items()):
+            key = (dest, id(sender))
+            live.add(key)
+            full = sender.frames_in_flight >= sender.inflight_cap
+            if full and flat:
+                rounds = self._lane_full.get(key, 0) + 1
+            else:
+                rounds = 0
+                self._lane_stuck.discard(key)
+            self._lane_full[key] = rounds
+            if rounds >= _STALL_ROUNDS and key not in self._lane_stuck:
+                self._lane_stuck.add(key)
+                self.emit(KIND_STUCK_LANE, None,
+                          f"window toward {dest} full "
+                          f"({sender.frames_in_flight}/"
+                          f"{sender.inflight_cap} frames) for "
+                          f"{rounds * self.interval_s:.1f}s with the "
+                          f"commit waterline flat at {commits}")
+        for key in list(self._lane_full):
+            if key not in live:
+                self._lane_full.pop(key, None)
+        self._lane_stuck &= live

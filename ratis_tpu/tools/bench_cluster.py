@@ -1294,6 +1294,20 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["append_rewinds"] = sum(
             s2.replication.metrics.get("rewinds", 0)
             for s2 in cluster.servers)
+        # round-9 append-window state: peak frames-in-flight over the rung
+        # as a fraction of the envelope-slot capacity (the "did the
+        # pipeline actually fill" number), plus the windowed-rewind /
+        # lane-recovery counters
+        result["window_occupancy"] = round(max(
+            (s2.replication.metrics.get("win_hwm", 0)
+             / max(1, s2.replication.lane_slots))
+            for s2 in cluster.servers), 4)
+        result["window_rewinds"] = sum(
+            s2.replication.metrics.get("windowed_rewinds", 0)
+            for s2 in cluster.servers)
+        result["lane_resets"] = sum(
+            s2.replication.metrics.get("lane_resets", 0)
+            for s2 in cluster.servers)
         from ratis_tpu.server.replication import ReplicationScheduler
         result["codec"] = ReplicationScheduler.codec_stats()
         if transport == "grpc":

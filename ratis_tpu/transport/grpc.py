@@ -29,11 +29,14 @@ import grpc
 import grpc.aio
 import msgpack
 
+from ratis_tpu.metrics.hops import hop
 from ratis_tpu.protocol.exceptions import RaftException, TimeoutIOException
 from ratis_tpu.protocol.ids import RaftPeerId
 from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope,
                                         decode_rpc, encode_rpc)
-from ratis_tpu.protocol.requests import RaftClientReply, RaftClientRequest
+from ratis_tpu.protocol.requests import (DEFERRED_REPLY, RaftClientReply,
+                                         RaftClientRequest,
+                                         attach_reply_sink)
 from ratis_tpu.trace.tracer import (INGRESS_NS, STAGE_DECODE, STAGE_ENCODE,
                                     STAGE_RESPOND, STAGE_WIRE, TRACER)
 from ratis_tpu.transport.base import (ClientRequestHandler, ClientTransport,
@@ -243,6 +246,80 @@ class _StreamChunkCoalescer(WriteCoalescer):
             frames[0] if len(frames) == 1 else frames))
 
 
+class _DeferredStreamFanout:
+    """Per-stream deferred-reply batcher (commit fan-out collapse on the
+    gRPC bidi client stream — the transport analog of the TCP
+    ``_DeferredReplyFanout``): the division's waterline fan-out calls
+    :meth:`submit` synchronously (possibly from a shard loop); replies
+    queue here and ONE armed callback per burst drains them into the
+    stream's reply queue, where the generator's batch-what's-ready fold
+    ships them — one scheduled hop per burst per stream instead of one
+    handler-resume + reply-write chain per request."""
+
+    __slots__ = ("_loop", "_replies", "_q", "_lock", "_armed")
+
+    def __init__(self, loop: asyncio.AbstractEventLoop,
+                 replies: asyncio.Queue) -> None:
+        import collections
+        import threading
+        self._loop = loop
+        self._replies = replies
+        self._q = collections.deque()
+        self._lock = threading.Lock()
+        self._armed = False
+
+    def sink_for(self, call_id: int, trace_id: int = 0):
+        def sink(reply: RaftClientReply) -> None:
+            self.submit(call_id, reply, trace_id)
+        return sink
+
+    def submit(self, call_id: int, reply: RaftClientReply,
+               trace_id: int = 0) -> None:
+        tid = trace_id if TRACER.enabled else 0
+        t0 = TRACER.now() if tid else 0
+        # encode on the CALLING (division) loop: serialization stays off
+        # the stream's loop, which only forwards the finished chunks
+        body = reply.to_bytes()
+        with self._lock:
+            self._q.append(([call_id, _ST_OK, body], tid, t0))
+            if self._armed:
+                return
+            self._armed = True
+        hop("reply_flush")
+        try:
+            self._loop.call_soon_threadsafe(self._drain)
+        except RuntimeError:
+            pass  # stream loop closed: the client sees a dead stream
+
+    def _drain(self) -> None:
+        with self._lock:
+            items = list(self._q)
+            self._q.clear()
+            self._armed = False
+        now = TRACER.now() if TRACER.enabled else 0
+        backlog: list = []
+        for out, tid, t0 in items:
+            if backlog:
+                backlog.append(out)
+            else:
+                try:
+                    self._replies.put_nowait(out)
+                except asyncio.QueueFull:
+                    # reply order across call ids is irrelevant (replies
+                    # are id-matched); overflow rides one catch-up task
+                    backlog.append(out)
+            if tid and t0:
+                # respond span (deferred shape): reply ready at the
+                # division -> handed to this stream's reply fold
+                TRACER.record(tid, STAGE_RESPOND, t0, now, tag=len(out[2]))
+        if backlog:
+            self._loop.create_task(self._put_backlog(backlog))
+
+    async def _put_backlog(self, outs: list) -> None:
+        for out in outs:
+            await self._replies.put(out)
+
+
 class _AppendStreamClient:
     """One ordered bidi stream to a peer carrying entry-bearing
     AppendEntries (reference GrpcLogAppender's appendEntries stream,
@@ -375,12 +452,18 @@ class GrpcServerTransport(ServerTransport):
                  client_port: Optional[int] = None,
                  admin_port: Optional[int] = None,
                  admin_tls: Optional[GrpcTlsConfig] = None,
-                 flush_micros: int = 0, flush_chunks: int = 64):
+                 flush_micros: int = 0, flush_chunks: int = 64,
+                 defer_replies: bool = False):
         self.peer_id = peer_id
         # stream-framing coalescing (raft.tpu.grpc.*): 0µs = one chunk per
         # stream message, the pre-round-6 wire shape
         self.flush_micros = flush_micros
         self.flush_chunks = max(1, flush_chunks)
+        # commit fan-out collapse (raft.tpu.replication.reply-fanout):
+        # attach a per-stream deferred-reply sink to client requests so
+        # replies ride the waterline fan-out instead of per-request
+        # handler resumes (the TCP transport's defer_replies analog)
+        self.defer_replies = defer_replies
         # observability for the keyed-FIFO dispatch + framing coalescing
         # (ADVICE r5: make reorder churn and batching measurable)
         self.dispatch_metrics = {"stream_chunks": 0, "keyed_chunks": 0,
@@ -442,7 +525,8 @@ class GrpcServerTransport(ServerTransport):
     # handler tasks)
     _STREAM_CONCURRENCY = 256
 
-    async def _serve_stream(self, request_iterator, dispatch, classify=None):
+    async def _serve_stream(self, request_iterator, dispatch, classify=None,
+                            defer: bool = False):
         """Shared server scaffold for the multiplexed bidi streams (append
         plane and client plane): chunks are handled CONCURRENTLY (a slow
         division flush must not head-of-line-block every co-hosted group
@@ -475,6 +559,11 @@ class GrpcServerTransport(ServerTransport):
         tasks: set[asyncio.Task] = set()
         last_by_key: dict[object, asyncio.Future] = {}
         metrics = self.dispatch_metrics
+        # deferred-reply fan-out (commit fan-out collapse): dispatch gets
+        # (fanout, call_id) and may return None — the reply arrives later
+        # through the fanout's thread-safe drain into this reply queue
+        fanout = (_DeferredStreamFanout(asyncio.get_running_loop(), replies)
+                  if defer else None)
 
         async def run_one(call_id: int, work, prev, done) -> None:
             try:
@@ -487,7 +576,12 @@ class GrpcServerTransport(ServerTransport):
                     except Exception:
                         pass
                 try:
-                    out = [call_id, _ST_OK, await dispatch(work)]
+                    res = await (dispatch(work, (fanout, call_id))
+                                 if fanout is not None else dispatch(work))
+                    # None = deferred: the waterline fan-out delivers the
+                    # reply through this stream's fanout at commit
+                    out = (None if res is None
+                           else [call_id, _ST_OK, res])
                 except RaftException as e:
                     out = [call_id, _ST_RAFT_ERROR, str(e).encode()]
                 except asyncio.CancelledError:
@@ -500,7 +594,8 @@ class GrpcServerTransport(ServerTransport):
                 # reply-write guarantee
                 if not done.done():
                     done.set_result(None)
-                await replies.put(out)
+                if out is not None:
+                    await replies.put(out)
             finally:
                 if not done.done():
                     done.set_result(None)
@@ -610,15 +705,21 @@ class GrpcServerTransport(ServerTransport):
         Unary (per-group) entry appends are KEYED by group id so same-group
         chunks dispatch in arrival order (scalar mode pipelines a window of
         them concurrently on this stream — the reorder surface ADVICE r5
-        flagged).  Coalesced AppendEnvelopes stay unkeyed: the sender's
-        busy latch guarantees a group's items are never split across two
-        in-flight envelopes, so envelopes toward this server are
+        flagged).  SEQUENCED envelopes (append-window pipelining,
+        raft.tpu.replication.window-depth > 1) are keyed by lane: their
+        frames may share groups, and dispatching a lane's frames in stream
+        arrival order keeps the server's lane intake on its buffer-free
+        happy path.  Unsequenced envelopes stay unkeyed: their sender's
+        depth-1 busy latch guarantees a group's items are never split
+        across two in-flight envelopes, so those envelopes are
         group-disjoint and safely concurrent."""
 
         def classify(payload: bytes):
             msg = decode_rpc(payload)
             if isinstance(msg, AppendEntriesRequest) and msg.entries:
                 return msg, ("g", msg.header.group_id.to_bytes())
+            if isinstance(msg, AppendEnvelope) and msg.seq >= 0:
+                return msg, ("l", msg.lane)
             return msg, None
 
         async def dispatch(msg) -> bytes:
@@ -633,9 +734,16 @@ class GrpcServerTransport(ServerTransport):
         GrpcClientProtocolService.java ordered stream): same id-matched
         concurrent-chunk shape as the append stream — one HTTP/2 stream per
         (client, server) instead of one per request, which is where
-        grpc.aio's per-unary-call overhead was going at 1024 groups."""
+        grpc.aio's per-unary-call overhead was going at 1024 groups.
 
-        async def dispatch(payload: bytes) -> bytes:
+        With ``defer_replies`` (commit fan-out collapse,
+        raft.tpu.replication.reply-fanout) each request gets a deferred
+        reply sink into the stream's fan-out batcher: the handler chain
+        ends at append time, and the commit waterline delivers the reply
+        through one drained burst per stream — gRPC now rides the same
+        collapsed reply plane as TCP and sim."""
+
+        async def dispatch(payload: bytes, defer_ctx=None):
             t0 = TRACER.now() if TRACER.enabled else 0
             request = RaftClientRequest.from_bytes(payload)
             if t0 and request.trace_id:
@@ -643,14 +751,24 @@ class GrpcServerTransport(ServerTransport):
                 TRACER.record(request.trace_id, STAGE_DECODE, t0,
                               now, tag=len(payload))
                 INGRESS_NS.set(now)  # route span starts post-decode
-            reply_bytes = (await self.client_handler(request)).to_bytes()
+            if defer_ctx is not None:
+                fanout, call_id = defer_ctx
+                attach_reply_sink(
+                    request, fanout.sink_for(call_id, request.trace_id))
+            reply = await self.client_handler(request)
+            if reply is DEFERRED_REPLY:
+                # reply rides the stream's fan-out batcher at commit;
+                # this dispatch is done at append time
+                return None
+            reply_bytes = reply.to_bytes()
             egress = TRACER.pop_egress(request.trace_id)
             if egress:
                 TRACER.record(request.trace_id, STAGE_RESPOND, egress,
                               TRACER.now(), tag=len(reply_bytes))
             return reply_bytes
 
-        async for item in self._serve_stream(request_iterator, dispatch):
+        async for item in self._serve_stream(request_iterator, dispatch,
+                                             defer=self.defer_replies):
             yield item
 
     def _client_handlers(self):
@@ -1021,6 +1139,17 @@ def _grpc_flush_conf(properties) -> tuple[int, int]:
             WireConfigKeys.Grpc.flush_chunks(properties))
 
 
+def _grpc_defer_conf(properties) -> bool:
+    """Whether client requests on the bidi stream get a deferred-reply
+    sink attached (commit fan-out collapse; same gate as the TCP
+    transport's)."""
+    if properties is None:
+        return False
+    from ratis_tpu.conf.keys import RaftServerConfigKeys
+    K = RaftServerConfigKeys.Replication
+    return K.sweep(properties) and K.reply_fanout(properties)
+
+
 class GrpcTransportFactory(TransportFactory):
     """The SupportedRpcType.GRPC factory (GrpcFactory.java)."""
 
@@ -1046,7 +1175,8 @@ class GrpcTransportFactory(TransportFactory):
                                    admin_port=admin_port,
                                    admin_tls=GrpcTlsConfig.admin_from_properties(
                                        properties),
-                                   flush_micros=fm, flush_chunks=fc)
+                                   flush_micros=fm, flush_chunks=fc,
+                                   defer_replies=_grpc_defer_conf(properties))
 
     def new_client_transport(self, properties=None) -> ClientTransport:
         fm, fc = _grpc_flush_conf(properties)

@@ -41,3 +41,56 @@ def _clear_injections():
     yield
     from ratis_tpu.util import injection
     injection.clear()
+
+
+# ------------------------------------------------------------ task hygiene
+#
+# The PeerSender/LogAppender inflight-task bookkeeping grows with the
+# round-9 append windows: a leak there (a task created but never awaited,
+# cancelled, or tracked through close()) would silently accumulate across
+# a long-lived server.  Every test therefore asserts that cluster teardown
+# left no lingering asyncio task behind: after ``asyncio.run`` returns,
+# any task that is still pending on a CLOSED loop can never run again —
+# a definite leak.  Tasks whose cancellation was at least REQUESTED
+# (``cancel()`` called, loop gone before it could unwind) are tolerated:
+# they were tracked and asked to die; the loop's death froze them.
+
+_reported_leaks = None  # lazy WeakSet: a leak fails exactly one test
+
+
+def _pending_leaked_tasks() -> list:
+    import asyncio.tasks as _tasks
+    global _reported_leaks
+    if _reported_leaks is None:
+        import weakref
+        _reported_leaks = weakref.WeakSet()
+    leaked = []
+    for t in list(getattr(_tasks, "_all_tasks", ())):
+        try:
+            if t.done() or not t.get_loop().is_closed():
+                continue
+            if getattr(t, "_must_cancel", False):
+                continue  # cancel() was requested; the loop died first
+            if t in _reported_leaks:
+                continue  # already failed an earlier test for this task
+        except Exception:
+            continue
+        _reported_leaks.add(t)
+        leaked.append(t)
+    return leaked
+
+
+@pytest.fixture(autouse=True)
+def _no_lingering_tasks():
+    yield
+    leaked = _pending_leaked_tasks()
+    if leaked:
+        names = []
+        for t in leaked:
+            try:
+                names.append(t.get_coro().__qualname__)
+            except Exception:
+                names.append(repr(t))
+        pytest.fail(
+            f"{len(leaked)} asyncio task(s) leaked past cluster teardown "
+            f"(pending on a closed loop, never cancelled): {names}")

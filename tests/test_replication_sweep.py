@@ -131,3 +131,329 @@ def test_sweep_mode_has_no_standing_sender_tasks():
 
     assert all(t is None for t in asyncio.run(body(True)))
     assert all(t is not None for t in asyncio.run(body(False)))
+
+
+# ------------------------- round 9: sequenced append windows -------------
+
+def _install_chaos(network, *, drop_p: float = 0.0, dup_p: float = 0.0,
+                   delay_p: float = 0.0, seed: int = 0) -> dict:
+    """Wrap a SimulatedNetwork's server-RPC delivery with randomized
+    reorder/drop/duplicate injection on SEQUENCED append frames only (the
+    round-9 lane protocol's surface).  A random pre-delivery sleep bypasses
+    the hub's per-link FIFO clock, so later frames genuinely overtake
+    earlier ones."""
+    import random
+
+    from ratis_tpu.protocol.exceptions import TimeoutIOException
+    from ratis_tpu.protocol.raftrpc import AppendEnvelope
+
+    rng = random.Random(seed)
+    orig = network.deliver_server_rpc
+    stats = {"dropped": 0, "duplicated": 0, "delayed": 0, "frames": 0}
+
+    async def chaotic(src, dst, msg):
+        if isinstance(msg, AppendEnvelope) and msg.seq >= 0:
+            stats["frames"] += 1
+            r = rng.random()
+            if r < drop_p:
+                stats["dropped"] += 1
+                raise TimeoutIOException("chaos: dropped lane frame")
+            if r < drop_p + dup_p:
+                stats["duplicated"] += 1
+                reply = await orig(src, dst, msg)
+                try:
+                    await orig(src, dst, msg)  # duplicate delivery
+                except Exception:
+                    pass
+                return reply
+            if r < drop_p + dup_p + delay_p:
+                stats["delayed"] += 1
+                await asyncio.sleep(rng.uniform(0.0, 0.01))
+        return await orig(src, dst, msg)
+
+    network.deliver_server_rpc = chaotic
+    return stats
+
+
+async def _windowed_chaos_rung(depth: int, groups: int = 8,
+                               writes: int = 3, pipeline: int = 4,
+                               **chaos) -> dict:
+    """Drive ordered writes through a sim cluster running the sequenced
+    window protocol under injected frame chaos; returns counters plus the
+    per-group final SM values (exactly-once evidence)."""
+    from ratis_tpu.client import RaftClient
+    from ratis_tpu.engine.engine import QuorumEngine
+    from ratis_tpu.tools.bench_cluster import BenchCluster
+
+    regressions = []
+    orig_regress = QuorumEngine.regress_match
+
+    def counting_regress(self, slot, peer_slot, match_index):
+        regressions.append((slot, peer_slot, match_index))
+        return orig_regress(self, slot, peer_slot, match_index)
+
+    QuorumEngine.regress_match = counting_regress
+    # batched=False keeps the scalar engine (no jit warmup) but pins the
+    # pre-sweep baseline paths — re-enable the sweep + coalescing the
+    # window protocol rides on top of
+    cluster = BenchCluster(
+        groups, num_servers=3, batched=False, transport="sim",
+        extra_props={
+            "raft.tpu.replication.window-depth": str(depth),
+            "raft.tpu.replication.sweep": "1",
+            "raft.server.log.appender.coalescing.enabled": "true",
+        })
+    try:
+        await cluster.start()
+        stats = _install_chaos(cluster.network, **chaos)
+
+        async def one_group(g):
+            client = (RaftClient.builder()
+                      .set_raft_group(g)
+                      .set_transport(cluster.factory.new_client_transport(
+                          cluster.properties))
+                      .set_properties(cluster.properties)
+                      .build())
+            try:
+                io = client.io()
+                for _ in range(writes):
+                    replies = await asyncio.gather(
+                        *(io.send(b"INCREMENT") for _ in range(pipeline)))
+                    assert all(r.success for r in replies), \
+                        "lost ack under frame chaos"
+                r = await io.send_read_only(b"GET")
+                return int(r.message.content)
+            finally:
+                await client.close()
+
+        values = await asyncio.gather(*(one_group(g)
+                                        for g in cluster.groups))
+        metrics = dict(cluster.servers[0].replication.metrics)
+        lane_metrics = [dict(s.lane_metrics) for s in cluster.servers]
+        return {"values": values, "stats": stats, "metrics": metrics,
+                "lane_metrics": lane_metrics, "regressions": regressions}
+    finally:
+        QuorumEngine.regress_match = orig_regress
+        await cluster.close()
+
+
+def test_window_zero_loss_under_reorder_drop_duplicate():
+    """Randomized reorder/drop/duplicate injection over the sim transport:
+    every ack arrives, every group's state machine lands at EXACTLY
+    writes*pipeline (no lost, duplicated, or reordered commit), and the
+    INCONSISTENCY guard never regresses a match index (no volatile-log
+    restart happened, so any regression would be a protocol bug)."""
+    out = asyncio.run(_windowed_chaos_rung(
+        4, drop_p=0.05, dup_p=0.05, delay_p=0.25, seed=7))
+    assert out["values"] == [3 * 4] * 8, out["values"]
+    assert out["stats"]["frames"] > 0, "chaos never saw a sequenced frame"
+    assert out["metrics"]["seq_frames"] > 0, \
+        "window protocol was not engaged"
+    assert out["regressions"] == [], \
+        f"chaos regressed match indexes: {out['regressions']}"
+
+
+def test_window_rewind_storm_keeps_match_monotonic():
+    """Rewind storm: a high drop rate forces lane resets and windowed
+    rewinds while frames stay pipelined; the storm must neither lose a
+    commit nor ever resurrect/regress a match index (the request-capped
+    SUCCESS rule and the flush-before-non-SUCCESS ordering guard hold
+    under pipelining)."""
+    out = asyncio.run(_windowed_chaos_rung(
+        16, groups=6, writes=3, pipeline=4, drop_p=0.2, delay_p=0.2,
+        seed=11))
+    assert out["values"] == [3 * 4] * 6, out["values"]
+    assert out["stats"]["dropped"] > 0, "storm never dropped a frame"
+    # dropped sequenced frames surface as lane resets (sender re-cuts)
+    assert out["metrics"]["lane_resets"] > 0, out["metrics"]
+    assert out["regressions"] == [], \
+        f"rewind storm regressed match indexes: {out['regressions']}"
+
+
+def test_depth1_is_bit_identical_to_legacy():
+    """window-depth=1 is the deterministic fallback: frames go out
+    UNSEQUENCED with wire bytes identical to the pre-window protocol, the
+    one-frame-per-group latch holds (seq_frames stays 0), and the rung
+    commits the identical workload."""
+    import msgpack
+
+    from ratis_tpu.protocol.ids import RaftGroupId, RaftPeerId
+    from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest,
+                                            AppendEnvelope, RaftRpcHeader,
+                                            _encode, decode_rpc)
+    from ratis_tpu.protocol.termindex import TermIndex
+
+    reqs = tuple(
+        AppendEntriesRequest(
+            RaftRpcHeader(RaftPeerId.value_of("s0"),
+                          RaftPeerId.value_of(f"s{i}"),
+                          RaftGroupId.random_id(), 3),
+            2, TermIndex(1, 4), (), 5, False, ())
+        for i in (1, 2))
+    # depth-1 frame (default lane/seq): bytes must equal the legacy
+    # single-key envelope encoding exactly
+    legacy = msgpack.packb(
+        {"_": "env_req", "b": {"i": [r.to_dict() for r in reqs]}},
+        use_bin_type=True)
+    assert _encode(AppendEnvelope(reqs)) == legacy
+    # sequenced frame: fast path must stay bit-compatible with the
+    # generic packer and round-trip lane/seq
+    env = AppendEnvelope(reqs, lane=(7 << 32) | 9, seq=3)
+    fast = _encode(env)
+    assert fast == msgpack.packb({"_": "env_req", "b": env.to_dict()},
+                                 use_bin_type=True)
+    back = decode_rpc(fast)
+    assert (back.lane, back.seq) == (env.lane, env.seq)
+
+    out1 = asyncio.run(_windowed_chaos_rung(1, groups=4, writes=2,
+                                            pipeline=4))
+    outd = asyncio.run(_windowed_chaos_rung(4, groups=4, writes=2,
+                                            pipeline=4))
+    # identical committed workload either depth
+    assert out1["values"] == [2 * 4] * 4
+    assert outd["values"] == [2 * 4] * 4
+    # depth 1: zero sequenced frames, zero lane traffic — the exact
+    # latched legacy protocol; depth 4: the lane path carried frames
+    assert out1["metrics"]["seq_frames"] == 0, out1["metrics"]
+    assert all(m["lane_frames"] == 0 for m in out1["lane_metrics"])
+    assert outd["metrics"]["seq_frames"] > 0, outd["metrics"]
+    assert any(m["lane_frames"] > 0 for m in outd["lane_metrics"])
+
+
+def test_window_state_metrics_and_stuck_lane_watchdog():
+    """Window state is observable: the replication_plane registry carries
+    the per-destination frames-in-flight/occupancy gauges and rewind/
+    out-of-order counters, and the watchdog journals a stuck-lane event
+    when a sender's window stays full while commits are flat."""
+    from ratis_tpu.server.watchdog import KIND_STUCK_LANE, StallWatchdog
+    from ratis_tpu.tools.bench_cluster import BenchCluster
+
+    async def body():
+        cluster = BenchCluster(
+            4, num_servers=3, batched=False, transport="sim",
+            extra_props={
+                "raft.tpu.replication.window-depth": "4",
+                "raft.tpu.replication.sweep": "1",
+                "raft.server.log.appender.coalescing.enabled": "true",
+            })
+        await cluster.start()
+        try:
+            await _drive_ordered(cluster, writes_per_group=1, pipeline=2)
+            server = cluster.servers[0]
+            from ratis_tpu.metrics.registry import MetricRegistries
+            reg = MetricRegistries.global_registries().get(
+                server._plane_info)
+            names = set(reg.metric_names())
+            assert "windowDepth" in names
+            assert "windowRewinds" in names
+            assert "laneOutOfOrderBuffered" in names
+            assert any(n.startswith("windowFramesInFlight{")
+                       for n in names), sorted(names)
+            assert any(n.startswith("windowOccupancy{") for n in names)
+            # force the stuck-lane shape: a full window + flat commits
+            wd = StallWatchdog(server, interval_s=60.0)
+            try:
+                senders = list(server.replication._senders.values())
+                assert senders
+                s = senders[0]
+                saved = s._frames_out
+                s._frames_out = s.inflight_cap  # window pinned full
+                wd.sample()  # establishes the commit baseline
+                wd.sample()  # flat round 1
+                wd.sample()  # flat round 2 -> episode event
+                s._frames_out = saved
+                kinds = [e["kind"] for e in wd.events()]
+                assert KIND_STUCK_LANE in kinds, kinds
+            finally:
+                await wd.close()
+        finally:
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+def test_task_leak_detector_catches_uncancelled_tasks():
+    """Shutdown hygiene (tests/conftest.py): a task left pending on a
+    closed loop without a cancel request is reported as a leak exactly
+    once — the failure mode the PeerSender/LogAppender inflight-task
+    bookkeeping must never produce."""
+    import sys
+
+    # use the conftest instance pytest actually loaded (a fresh
+    # `import tests.conftest` would carry its own reported-leaks set and
+    # the autouse fixture would re-report our deliberate leak)
+    conftest = next(m for n, m in sys.modules.items()
+                    if n.endswith("conftest")
+                    and hasattr(m, "_pending_leaked_tasks"))
+    _pending_leaked_tasks = conftest._pending_leaked_tasks
+
+    async def naptime():
+        await asyncio.sleep(60)
+
+    loop = asyncio.new_event_loop()
+    try:
+        task = loop.create_task(naptime())
+        loop.run_until_complete(asyncio.sleep(0))  # let the task start
+    finally:
+        loop.close()  # closed with the task still pending: a leak
+    leaked = _pending_leaked_tasks()
+    assert task in leaked, "leak detector missed a pending task"
+    # reported exactly once: the autouse fixture must not re-fail every
+    # later test for the same (deliberate) leak
+    assert task not in _pending_leaked_tasks()
+
+
+async def _latency_rung_elapsed(depth: int, delay_ms: float = 10.0,
+                                groups: int = 2, writes: int = 2,
+                                pipeline: int = 8) -> float:
+    """Seconds to drive ``writes`` rounds of ``pipeline`` concurrent
+    ordered writes per group through a sim cluster whose every hop costs
+    ``delay_ms``, with 1-entry batches and ~1-item frames — the shape
+    where the FRAME window is the only latency-hiding lever (the
+    per-request pipeline window is held constant at its default)."""
+    import time
+
+    from ratis_tpu.tools.bench_cluster import BenchCluster
+
+    cluster = BenchCluster(
+        groups, num_servers=3, batched=False, transport="sim",
+        extra_props={
+            "raft.tpu.replication.window-depth": str(depth),
+            "raft.tpu.replication.sweep": "1",
+            "raft.server.log.appender.coalescing.enabled": "true",
+            # 1-byte budgets: one entry per request, ~one item per frame,
+            # so frames cannot hide latency behind giant batches — the
+            # depth knob is isolated (same trick as
+            # tests/test_appender_pipeline.py at the request level)
+            "raft.server.log.appender.buffer.byte-limit": "1",
+            "raft.server.log.appender.envelope.byte-limit": "1",
+        })
+    await cluster.start()
+    try:
+        # warm leadership + first commit BEFORE injecting latency
+        await _drive_ordered(cluster, writes_per_group=1, pipeline=1)
+        cluster.network.base_delay_ms = delay_ms
+        t0 = time.monotonic()
+        await _drive_ordered(cluster, writes_per_group=writes,
+                             pipeline=pipeline)
+        return time.monotonic() - t0
+    finally:
+        cluster.network.base_delay_ms = 0.0
+        await cluster.close()
+
+
+@pytest.mark.slow
+def test_frame_window_hides_append_round_trip():
+    """The tentpole's mechanism, isolated: with real per-hop latency and
+    one-entry frames, depth 1 pays a full RTT of dead time per frame per
+    group while depth 8 keeps the lane full — >=2x wall-clock speedup
+    (the latency-bound analog of the request-window test in
+    tests/test_appender_pipeline.py, one level up the stack)."""
+
+    async def main():
+        stop_and_wait = await _latency_rung_elapsed(1)
+        pipelined = await _latency_rung_elapsed(8)
+        assert pipelined * 2 <= stop_and_wait, (
+            f"pipelined={pipelined:.3f}s stop_and_wait={stop_and_wait:.3f}s")
+
+    asyncio.run(main())

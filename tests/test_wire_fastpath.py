@@ -205,6 +205,8 @@ def test_encode_once_fanout_bit_identity():
     _entries, reqs = _fanout_case()
     fallback0 = FANOUT_STATS["fallback"]
     for msg in (*reqs, AppendEnvelope(reqs),
+                # sequenced lane frame (round-9 append windows)
+                AppendEnvelope(reqs, lane=(123 << 32) | 45, seq=6),
                 # heartbeat: no entries, no previous
                 AppendEntriesRequest(
                     RaftRpcHeader(RaftPeerId.value_of("s0"),
@@ -399,7 +401,8 @@ def test_bench_summary_line_fits_driver_window():
         out = {"commits_per_sec": 123456.8, "p50_ms": 99999.99,
                "p99_ms": 99999.99, "election_convergence_s": 9999.99,
                "write_failures": 0, "engine_occupancy": 0.9999,
-               "watchdog_events": 99999, "reply_hops_per_commit": 99.999}
+               "watchdog_events": 99999, "reply_hops_per_commit": 99.999,
+               "window_occupancy": 0.9999}
         out.update(extra)
         return out
 
@@ -437,7 +440,9 @@ def test_bench_summary_line_fits_driver_window():
                      reads_follower_linearizable=99999,
                      reads_stale=99999),
         snapcatch=rung(catchup_s=9999.99, installs=10240,
-                       cps_before=123456.8))
+                       cps_before=123456.8),
+        win_sweep={str(d): [123456.8, 99999.99, 0.9999]
+                   for d in (1, 4, 16)})
     line = json.dumps(summary, separators=(",", ":"))
     assert len(line) < 2000, f"bench line would overflow: {len(line)} chars"
     parsed = json.loads(line)
@@ -449,6 +454,10 @@ def test_bench_summary_line_fits_driver_window():
     assert parsed["secondary"]["readmix"][1] == 123456.8
     assert parsed["secondary"]["snap_1024"][1] == 10240
     # observability keys: [engine occupancy, watchdog event count,
-    # reply-plane scheduling hops per commit (round-8 fan-out collapse)]
-    assert parsed["secondary"]["obs"] == [0.9999, 99999 * 6, 99.999]
+    # reply-plane scheduling hops per commit (round-8 fan-out collapse),
+    # append-window occupancy (round-9 pipelined windows)]
+    assert parsed["secondary"]["obs"] == [0.9999, 99999 * 6, 99.999,
+                                          0.9999]
+    assert parsed["secondary"]["win_sweep"]["16"] == [123456.8, 99999.99,
+                                                      0.9999]
     assert "batched_commits_per_sec" in parsed["secondary"]["grpc_1024"]
