@@ -336,6 +336,28 @@ def child_snapcatch() -> None:
     asyncio.run(main())
 
 
+def child_chaos() -> None:
+    """chaos_1024 rung (ROADMAP open item 5): the standing chaos
+    campaign at the 1024-group batched shape — >= 6 scripted fault
+    scenario types (partitions, asymmetric blackholes, degraded links,
+    crash/restart, leader churn, slow follower, slow disk on durable
+    segmented logs), each asserting recovery SLOs, every fault journaled
+    through /events, failures replayable via
+    ratis_tpu.tools.chaos_replay (ratis_tpu.chaos.campaign)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.chaos.campaign import run_chaos_1024
+
+    async def main():
+        out = await run_chaos_1024(
+            seed=int(os.environ.get("RATIS_CHAOS_SEED", "1")))
+        print("RESULT " + json.dumps(out), flush=True)
+        os._exit(0)  # measurement child: skip the 3072-division unwind
+
+    asyncio.run(main())
+
+
 def child_kernel() -> None:
     import jax
     import jax.numpy as jnp
@@ -630,6 +652,10 @@ def main() -> None:
                          allow_dnf=True)
     snapcatch = _run_child(["--snapcatch-child"], timeout_s=1200.0,
                            allow_dnf=True)
+    # Chaos campaign rung (ROADMAP item 5): correctness-under-stress as
+    # a measured artifact at the 1024-group batched shape.
+    chaos = _run_child(["--chaos-child"], timeout_s=1800.0,
+                       allow_dnf=True)
     kernel = _run_child(["--kernel-child"])
     kernel_100k = _run_child(["--kernel-100k-child"], timeout_s=900.0,
                              allow_dnf=True)
@@ -652,7 +678,7 @@ def main() -> None:
         grpc_s_1024=grpc_s_1024, grpc_s_256=grpc_s_256, kernel=kernel,
         kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced,
         filestore5=filestore5, readmix=readmix, snapcatch=snapcatch,
-        win_sweep=win_sweep),
+        win_sweep=win_sweep, chaos=chaos),
         separators=(",", ":")))
 
 
@@ -736,6 +762,15 @@ def _write_definition() -> None:
         "occupancy]; depth 1 is the latched stop-and-wait-per-group "
         "fallback, so depth-1 vs default attributes the gain to the "
         "pipelined append round trip (docs/replication.md).\n"
+        "- secondary.chaos_1024: the round-10 chaos campaign at the "
+        "1024-group batched shape (durable segmented logs): [scenarios "
+        "passed, total, worst re-election convergence s, recovery-"
+        "throughput fraction, injected-fault /events records].  Every "
+        "scenario asserts the recovery SLOs (convergence bound, zero "
+        "lost acks, exactly-once apply via the per-group counter "
+        "oracle, catch-up under load); a failing scenario's (seed, "
+        "scenario, journal) artifact replays bit-for-bit via "
+        "ratis_tpu.tools.chaos_replay (docs/chaos.md).\n"
         % (HEADLINE_TRIALS, HEADLINE_GROUPS))
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -773,7 +808,7 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                peer5_grpc_scalar, peer7, sparse_hib, sparse_plain, churn,
                mixed, stream, grpc_b, grpc_s_1024, grpc_s_256, kernel,
                kernel_100k, tpu_e2e, traced, filestore5, readmix,
-               snapcatch, win_sweep=None) -> dict:
+               snapcatch, win_sweep=None, chaos=None) -> dict:
     """Build the one-line JSON summary.  COMPACT by contract: the whole
     line must parse from the driver's 2000-char tail window (r5 lost its
     flagship number to overflow), so keys are short, numbers rounded, and
@@ -918,6 +953,15 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                           [snapcatch["catchup_s"], snapcatch["installs"],
                            snapcatch["commits_per_sec"],
                            snapcatch["cps_before"]]),
+            # chaos campaign at the 1024-group batched shape: [scenarios
+            # passed, total, worst re-election convergence s, recovery-
+            # throughput fraction (post-heal rate / pre-fault baseline,
+            # worst scenario), injected-fault /events records]
+            "chaos_1024": (
+                {"dnf": True} if chaos is None or chaos.get("dnf") else
+                [chaos["passed"], chaos["total"],
+                 chaos["worst_reelect_s"], chaos["recovery_frac"],
+                 chaos["fault_events"]]),
             "grpc_1024": {
                 "batched_commits_per_sec": _median(
                     [t["commits_per_sec"] for t in grpc_b]),
@@ -965,5 +1009,7 @@ if __name__ == "__main__":
         child_readmix()
     elif len(sys.argv) > 1 and sys.argv[1] == "--snapcatch-child":
         child_snapcatch()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--chaos-child":
+        child_chaos()
     else:
         main()

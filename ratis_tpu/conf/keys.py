@@ -492,6 +492,63 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Watchdog.CHURN_KEY,
                 RaftServerConfigKeys.Watchdog.CHURN_DEFAULT)
 
+    class Chaos:
+        """Chaos campaign subsystem (ratis_tpu.chaos; reference analogs:
+        RaftExceptionBaseTest, the kill/restart suites over simulated RPC,
+        CodeInjectionForTesting): deterministic, seed-replayable fault
+        scenarios — link partitions/latency/drop via the transport shim,
+        crash/restart with tail truncation, slow-disk/slow-follower
+        injection, leader-churn storms — each asserting recovery SLOs and
+        journaling every injected fault through the watchdog ``/events``
+        plane.  With ``enabled`` unset (the default) no transport ever
+        consults the link-fault table and the request paths are
+        untouched."""
+
+        ENABLED_KEY = "raft.tpu.chaos.enabled"
+        ENABLED_DEFAULT = False
+        SEED_KEY = "raft.tpu.chaos.seed"
+        SEED_DEFAULT = 0
+        # re-election convergence SLO: after a fault heals, every affected
+        # group must have a ready leader within this bound
+        CONVERGENCE_TIMEOUT_KEY = "raft.tpu.chaos.convergence-timeout"
+        CONVERGENCE_TIMEOUT_DEFAULT = TimeDuration.valueOf("30s")
+        # post-heal quiesce SLO: replication + apply must drain (commit ==
+        # applied on every live replica) within this bound
+        RECOVERY_TIMEOUT_KEY = "raft.tpu.chaos.recovery-timeout"
+        RECOVERY_TIMEOUT_DEFAULT = TimeDuration.valueOf("120s")
+        # failing scenarios write their (seed, scenario, journal) replay
+        # artifact here; "" = don't write artifacts
+        ARTIFACT_DIR_KEY = "raft.tpu.chaos.artifact-dir"
+        ARTIFACT_DIR_DEFAULT = ""
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Chaos.ENABLED_KEY,
+                RaftServerConfigKeys.Chaos.ENABLED_DEFAULT)
+
+        @staticmethod
+        def seed(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Chaos.SEED_KEY,
+                             RaftServerConfigKeys.Chaos.SEED_DEFAULT)
+
+        @staticmethod
+        def convergence_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Chaos.CONVERGENCE_TIMEOUT_KEY,
+                RaftServerConfigKeys.Chaos.CONVERGENCE_TIMEOUT_DEFAULT)
+
+        @staticmethod
+        def recovery_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Chaos.RECOVERY_TIMEOUT_KEY,
+                RaftServerConfigKeys.Chaos.RECOVERY_TIMEOUT_DEFAULT)
+
+        @staticmethod
+        def artifact_dir(p: RaftProperties) -> str:
+            return p.get(RaftServerConfigKeys.Chaos.ARTIFACT_DIR_KEY,
+                         RaftServerConfigKeys.Chaos.ARTIFACT_DIR_DEFAULT)
+
     class PauseMonitor:
         """Event-loop pause monitor (reference JvmPauseMonitor.java:38)."""
 

@@ -119,7 +119,12 @@ def _summarize_proc(scrape: dict) -> dict:
     def g(name: str, default=0.0):
         return metrics.get(name, default)
 
+    chaos = health.get("chaos") or {}
+    active_faults = (len(chaos.get("activeLinkFaults", []))
+                     + len(chaos.get("activeInjections", [])))
     return {
+        "chaosActiveFaults": active_faults,
+        "chaosInjections": chaos.get("activeInjections", []),
         "address": scrape.get("address"),
         "peer": health.get("peer"),
         "status": health.get("status"),

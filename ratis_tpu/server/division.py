@@ -853,7 +853,8 @@ class Division:
         conf = self.state.configuration
         index = self.state.log.next_index
         entry = conf.to_entry(self.state.current_term, index)
-        self.leader_ctx.startup_index = index
+        ctx = self.leader_ctx
+        ctx.startup_index = index
         st.first_leader_index[self.engine_slot] = index
         st.mark_dirty(self.engine_slot)
         try:
@@ -864,6 +865,16 @@ class Division:
             LOG.error("%s startup entry append failed: %s", self.member_id, e)
             await self.change_to_follower(self.state.current_term, None,
                                           reason=f"startup append failed: {e}")
+            return
+        if self.leader_ctx is not ctx or not self.is_leader():
+            # Deposed DURING the startup append (a higher-term append or
+            # vote landed in the await window and change_to_follower
+            # already unwound leader_ctx — an election-storm interleaving
+            # the chaos campaign hits at the 1024-group shape): the new
+            # role owns the division now; starting appenders for the dead
+            # context would crash (or leak a ghost leadership).
+            LOG.info("%s deposed during startup append; staying %s",
+                     self.member_id, self.role.name)
             return
         self.state.apply_log_entry_configuration(entry)
         self._engine_update_flush()

@@ -148,6 +148,12 @@ class LogWorker:
         await asyncio.shield(fut)
 
     async def _run(self) -> None:
+        from ratis_tpu.util import injection
+        # worker-start injection point (reference
+        # SegmentedRaftLogWorker.java:70 runs CodeInjectionForTesting at
+        # the top of its run loop): lets the chaos suite stall a device's
+        # whole log worker before it drains anything
+        await injection.execute(injection.RUN_LOG_WORKER, self.name)
         while True:
             if not self._queue:
                 self._wake.clear()
@@ -157,6 +163,11 @@ class LogWorker:
                 continue
             self._writes.inc(len(batch))
             self._batches.inc()
+            # per-flush-batch sync injection point (reference
+            # RaftServerImpl.java:1620's LOG_SYNC): a registered delay
+            # here is the slow-disk fault — every group sharing this
+            # device pays it, exactly like a real degraded disk
+            await injection.execute(injection.LOG_SYNC, self.name)
 
             def _do_io():
                 files = []

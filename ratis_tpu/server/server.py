@@ -832,7 +832,25 @@ class RaftServer:
             },
             "watchdogEvents": (self.watchdog.event_count()
                                if self.watchdog is not None else 0),
+            "chaos": self.chaos_info(),
         }
+
+    def chaos_info(self) -> dict:
+        """Active injected faults (the /health ``chaos`` block): link
+        faults touching this peer from the process-wide chaos table, plus
+        any registered code-injection points.  All-empty on a production
+        server (the table is only consulted with raft.tpu.chaos.enabled,
+        and nothing registers injections outside a campaign)."""
+        from ratis_tpu.chaos.link import link_faults
+        from ratis_tpu.util import injection as _inj
+        me = str(self.peer_id)
+        links = [f for f in link_faults().active()
+                 if f["src"] in (me, None) or f["dst"] in (me, None)]
+        points = [p for p in (_inj.APPEND_TRANSACTION, _inj.LOG_SYNC,
+                              _inj.RUN_LOG_WORKER, _inj.REQUEST_VOTE,
+                              _inj.APPEND_ENTRIES, _inj.INSTALL_SNAPSHOT)
+                  if _inj.is_registered(p)]
+        return {"activeLinkFaults": links, "activeInjections": points}
 
     def divisions_info(self) -> list:
         """GET /divisions: per-division introspection (role, term,

@@ -32,9 +32,17 @@ from ratis_tpu.protocol.logentry import LogEntry
 from ratis_tpu.server.state import MetadataIO
 
 
+_TMP_IDS = __import__("itertools").count(1)
+
+
 def atomic_write(path: pathlib.Path, data: bytes) -> None:
-    """tmp + fsync + rename (reference AtomicFileOutputStream)."""
-    tmp = path.with_name(path.name + ".tmp")
+    """tmp + fsync + rename (reference AtomicFileOutputStream).  The tmp
+    name is unique per call: two concurrent writers of the SAME target
+    (mass step-downs persisting raft-meta from racing to_thread workers
+    — found by the chaos campaign's leader-crash scenario at 1024
+    groups) must degrade to last-rename-wins, not to one of them
+    renaming the other's half-written (or already-consumed) tmp away."""
+    tmp = path.with_name(f"{path.name}.tmp{os.getpid()}.{next(_TMP_IDS)}")
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
@@ -126,13 +134,27 @@ class RaftStorageDirectory:
 
 class FileMetadataIO(MetadataIO):
     """ServerState's (term, votedFor) persistence over RaftStorageDirectory.
-    The blocking fsync runs in a thread so the event loop never stalls."""
+    The blocking fsync runs in a thread so the event loop never stalls.
+
+    Persists SERIALIZE per division and never regress the on-disk term:
+    a vote handler and an append handler can both drive a term update in
+    the same burst, and with unserialized to_thread workers the OLDER
+    term could land last on disk — a durable term regression that lets a
+    restarted node double-vote (found by the chaos campaign's election
+    storms)."""
 
     def __init__(self, directory: RaftStorageDirectory):
         self.directory = directory
+        self._lock = asyncio.Lock()
+        self._last_term = -1
 
     async def persist(self, term: int, voted_for: Optional[RaftPeerId]) -> None:
-        await asyncio.to_thread(self.directory.persist_metadata, term, voted_for)
+        async with self._lock:
+            if term < self._last_term:
+                return  # stale writer lost the race; newer term is on disk
+            self._last_term = term
+            await asyncio.to_thread(self.directory.persist_metadata, term,
+                                    voted_for)
 
     async def load(self) -> tuple[int, Optional[RaftPeerId]]:
         return self.directory.load_metadata()

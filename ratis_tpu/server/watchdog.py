@@ -48,8 +48,17 @@ KIND_COMMIT_STALL = "commit-stall"
 KIND_ELECTION_CHURN = "election-churn"
 KIND_FOLLOWER_LAG = "follower-lag"
 KIND_STUCK_LANE = "stuck-lane"
+# Chaos campaign journaling (ratis_tpu.chaos): every DELIBERATELY injected
+# fault lands in the same journal the organic detections use — paired
+# with a fault-recovered event once its recovery SLO was observed — so a
+# scrape of /events during a campaign shows faults and their recoveries
+# interleaved with whatever the fault actually broke.  An injected-fault
+# event without its recovery pair is an UNRECOVERED fault (the shell
+# health subcommand exits 1 on it).
+KIND_INJECTED_FAULT = "injected-fault"
+KIND_FAULT_RECOVERED = "fault-recovered"
 KINDS = (KIND_COMMIT_STALL, KIND_ELECTION_CHURN, KIND_FOLLOWER_LAG,
-         KIND_STUCK_LANE)
+         KIND_STUCK_LANE, KIND_INJECTED_FAULT, KIND_FAULT_RECOVERED)
 
 # consecutive flat samples (with pending requests) before a commit-stall
 # event is journaled: one flat interval is ordinary queueing, two is not
@@ -119,13 +128,20 @@ class StallWatchdog:
 
     # -------------------------------------------------------------- journal
 
-    def emit(self, kind: str, group: Optional[str], detail: str) -> None:
-        self.journal.append({
+    def emit(self, kind: str, group: Optional[str], detail: str,
+             fault: Optional[str] = None) -> None:
+        """``fault``: injected-fault correlation id — the same id on a
+        KIND_INJECTED_FAULT event and its KIND_FAULT_RECOVERED pair is
+        how consumers (shell health, chaos_replay) match them up."""
+        record = {
             "t": round(time.time(), 3),
             "kind": kind,
             "group": group,
             "detail": detail,
-        })
+        }
+        if fault is not None:
+            record["fault"] = fault
+        self.journal.append(record)
         c = self.event_counters.get(kind)
         if c is not None:
             c.inc()

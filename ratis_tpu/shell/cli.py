@@ -215,9 +215,14 @@ async def cmd_snapshot_create(args) -> int:
 async def cmd_health(args) -> int:
     """Cluster health from the observability plane: scrape one or more
     servers' introspection endpoints (``raft.tpu.metrics.http-port``) and
-    pretty-print liveness, engine freshness, per-division state, and the
-    stall watchdog's journal.  Exit 0 = every endpoint reachable and ok;
-    1 = any endpoint degraded, unreachable, or with journaled events."""
+    pretty-print liveness, engine freshness, per-division state, active
+    chaos-injected faults, and the stall watchdog's journal.  Exit 0 =
+    every endpoint reachable and ok; 1 = any endpoint degraded,
+    unreachable, with journaled (organic) events, with ACTIVE injected
+    faults, or with an injected-fault event whose recovery pair never
+    landed.  A recovered injected fault is printed as history and does
+    NOT degrade the exit status — a finished chaos campaign leaves a
+    healthy cluster healthy."""
     from ratis_tpu.metrics.aggregate import scrape_cluster
     endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
     if not endpoints:
@@ -239,6 +244,12 @@ async def cmd_health(args) -> int:
               f"lagMax={proc.get('followerLagMax')}")
         if proc.get("status") != "ok":
             rc = 1
+        if proc.get("chaosActiveFaults"):
+            rc = 1
+            inj = proc.get("chaosInjections") or []
+            print(f"    ACTIVE INJECTED FAULTS: "
+                  f"{proc['chaosActiveFaults']}"
+                  f"{' (injections: ' + ', '.join(inj) + ')' if inj else ''}")
     for dead in merged.get("unreachable", []):
         print(f"  UNREACHABLE {dead['address']}: {dead['error']}")
         rc = 1
@@ -260,20 +271,35 @@ async def cmd_health(args) -> int:
                       f"applied={d['lastApplied']} "
                       f"shard={d['loopShard']}"
                       f"{' | ' + fol if fol else ''}")
-    shown = 0
+    all_events: list = []
     for address in endpoints:
         from ratis_tpu.metrics.aggregate import fetch_json
         try:
             events = await fetch_json(address, "/events", args.timeout)
         except Exception:
             continue
-        for e in events.get("events", []):
-            if shown == 0:
-                print("watchdog events:")
-            shown += 1
-            rc = 1
-            group = f" [{e['group']}]" if e.get("group") else ""
-            print(f"  {address} {e['kind']}{group}: {e['detail']}")
+        all_events.extend((address, e) for e in events.get("events", []))
+    # injected-fault / fault-recovered pairing (ratis_tpu.chaos): a fault
+    # whose recovery event landed — on ANY endpoint — is campaign history,
+    # not a degradation; an unrecovered one fails health like an organic
+    # event does
+    recovered = {e.get("fault") for _a, e in all_events
+                 if e.get("kind") == "fault-recovered" and e.get("fault")}
+    shown = 0
+    for address, e in all_events:
+        kind = e.get("kind")
+        if kind == "fault-recovered":
+            continue  # shown through its injected pair below
+        if shown == 0:
+            print("watchdog events:")
+        shown += 1
+        group = f" [{e['group']}]" if e.get("group") else ""
+        if kind == "injected-fault" and e.get("fault") in recovered:
+            print(f"  {address} {kind}{group} (recovered): {e['detail']}")
+            continue
+        rc = 1
+        tag = " UNRECOVERED" if kind == "injected-fault" else ""
+        print(f"  {address} {kind}{group}{tag}: {e['detail']}")
     return rc
 
 

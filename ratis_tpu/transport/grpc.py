@@ -453,8 +453,12 @@ class GrpcServerTransport(ServerTransport):
                  admin_port: Optional[int] = None,
                  admin_tls: Optional[GrpcTlsConfig] = None,
                  flush_micros: int = 0, flush_chunks: int = 64,
-                 defer_replies: bool = False):
+                 defer_replies: bool = False, chaos: bool = False):
         self.peer_id = peer_id
+        # chaos link-fault gate (raft.tpu.chaos.enabled): armed server RPC
+        # sends consult the process-wide link-fault table
+        # (ratis_tpu.chaos.link) — partitions/latency/drop over gRPC
+        self.chaos = chaos
         # stream-framing coalescing (raft.tpu.grpc.*): 0µs = one chunk per
         # stream message, the pre-round-6 wire shape
         self.flush_micros = flush_micros
@@ -949,6 +953,17 @@ class GrpcServerTransport(ServerTransport):
 
     async def _send_server_rpc_on_home(self, to: RaftPeerId, msg):
         address = self._resolve(to)
+        if self.chaos:
+            from ratis_tpu.chaos.link import link_faults
+            faults = link_faults()
+            if faults:
+                # one gate covers the round trip on this transport: the
+                # unary/stream reply rides the same HTTP/2 connection, and
+                # the runner models asymmetric reply loss by faulting the
+                # (to, self) direction — which gates the peer's own sends
+                # and this sender's next forward hop equally
+                await faults.gate(self.peer_id, to)
+                await faults.gate(to, self.peer_id)
         # The DATA PLANE — entry-bearing appends and coalesced multi-group
         # envelopes — rides the long-lived per-peer bidi stream: one HTTP/2
         # stream amortizes grpc.aio's per-unary-call setup across every
@@ -1168,6 +1183,10 @@ class GrpcTransportFactory(TransportFactory):
         admin_port = (GrpcConfigKeys.admin_port(properties)
                       if properties is not None else None)
         fm, fc = _grpc_flush_conf(properties)
+        chaos = False
+        if properties is not None:
+            from ratis_tpu.conf.keys import RaftServerConfigKeys as _K
+            chaos = _K.Chaos.enabled(properties)
         return GrpcServerTransport(peer_id, address, server_handler,
                                    client_handler, peer_resolver, timeout_s,
                                    tls=GrpcTlsConfig.from_properties(properties),
@@ -1176,7 +1195,8 @@ class GrpcTransportFactory(TransportFactory):
                                    admin_tls=GrpcTlsConfig.admin_from_properties(
                                        properties),
                                    flush_micros=fm, flush_chunks=fc,
-                                   defer_replies=_grpc_defer_conf(properties))
+                                   defer_replies=_grpc_defer_conf(properties),
+                                   chaos=chaos)
 
     def new_client_transport(self, properties=None) -> ClientTransport:
         fm, fc = _grpc_flush_conf(properties)

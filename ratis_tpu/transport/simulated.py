@@ -197,12 +197,18 @@ class SimulatedNetwork:
 class SimulatedServerTransport(ServerTransport):
     def __init__(self, network: SimulatedNetwork, peer_id: RaftPeerId,
                  address: str, server_handler: ServerRpcHandler,
-                 client_handler: ClientRequestHandler):
+                 client_handler: ClientRequestHandler,
+                 chaos: bool = False):
         self.network = network
         self.peer_id = peer_id
         self._address = address
         self.server_handler = server_handler
         self.client_handler = client_handler
+        # chaos link-fault gate (raft.tpu.chaos.enabled): the scenario
+        # engine's fault plane, layered on top of the hub's own
+        # block/partition matrix so all three transports share one fault
+        # vocabulary (ratis_tpu.chaos.link)
+        self.chaos = chaos
         self.running = False
 
     async def start(self) -> None:
@@ -214,7 +220,18 @@ class SimulatedServerTransport(ServerTransport):
         self.network.deregister(self)
 
     async def send_server_rpc(self, to: RaftPeerId, msg):
-        return await self.network.deliver_server_rpc(self.peer_id, to, msg)
+        faults = None
+        if self.chaos:
+            from ratis_tpu.chaos.link import link_faults
+            faults = link_faults()
+            if faults:
+                await faults.gate(self.peer_id, to)
+        reply = await self.network.deliver_server_rpc(self.peer_id, to, msg)
+        if faults:
+            # independent reply-hop fault (asymmetric partitions): the
+            # peer processed the RPC but this sender never hears back
+            await faults.gate(to, self.peer_id)
+        return reply
 
     @property
     def address(self) -> str:
@@ -240,8 +257,13 @@ class SimulatedTransportFactory(TransportFactory):
     def new_server_transport(self, peer_id, address, server_handler,
                              client_handler, properties=None,
                              peer_resolver=None) -> ServerTransport:
+        chaos = False
+        if properties is not None:
+            from ratis_tpu.conf.keys import RaftServerConfigKeys
+            chaos = RaftServerConfigKeys.Chaos.enabled(properties)
         return SimulatedServerTransport(self.network, peer_id, address,
-                                        server_handler, client_handler)
+                                        server_handler, client_handler,
+                                        chaos=chaos)
 
     def new_client_transport(self, properties=None) -> ClientTransport:
         return SimulatedClientTransport(self.network)

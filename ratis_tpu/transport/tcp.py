@@ -399,7 +399,7 @@ class TcpServerTransport(ServerTransport):
                  request_timeout_s: float = 3.0,
                  tls: "TcpTlsConfig | None" = None,
                  flush_bytes: int = 0, flush_micros: int = 0,
-                 defer_replies: bool = False):
+                 defer_replies: bool = False, chaos: bool = False):
         self.peer_id = peer_id
         self._address = address
         self._bound_port: Optional[int] = None
@@ -407,6 +407,10 @@ class TcpServerTransport(ServerTransport):
         self.client_handler = client_handler
         self.peer_resolver = peer_resolver
         self.request_timeout_s = request_timeout_s
+        # chaos link-fault gate (raft.tpu.chaos.enabled): when armed,
+        # server RPC sends consult the process-wide link-fault table
+        # (ratis_tpu.chaos.link) — partitions/latency/drop on real sockets
+        self.chaos = chaos
         self.tls = tls
         self.flush_bytes = flush_bytes
         self.flush_micros = flush_micros
@@ -528,12 +532,22 @@ class TcpServerTransport(ServerTransport):
         address = self.peer_resolver(to) if self.peer_resolver else None
         if address is None:
             raise RaftException(f"unknown peer {to}")
+        faults = None
+        if self.chaos:
+            from ratis_tpu.chaos.link import link_faults
+            faults = link_faults()
+            if faults:
+                await faults.gate(self.peer_id, to)
         try:
             conn = await self._pool.get(address)
             kind, body = await conn.call(KIND_SERVER_RPC, encode_rpc(msg),
                                          self.request_timeout_s)
         except (ConnectionError, OSError) as e:
             raise TimeoutIOException(f"{self.peer_id}->{to}: {e}") from None
+        if faults:
+            # the reply hop can be degraded independently (asymmetric
+            # partitions): the peer processed the RPC but we never hear it
+            await faults.gate(to, self.peer_id)
         if kind == KIND_ERROR:
             raise _decode_error(body)
         return decode_rpc(body)
@@ -610,12 +624,15 @@ class TcpTransportFactory(TransportFactory):
             timeout_s = RaftServerConfigKeys.Rpc.request_timeout(
                 properties).seconds
         fb, fm = _flush_conf(properties)
+        chaos = (properties is not None
+                 and RaftServerConfigKeys.Chaos.enabled(properties))
         return TcpServerTransport(peer_id, address, server_handler,
                                   client_handler, peer_resolver=peer_resolver,
                                   request_timeout_s=timeout_s,
                                   tls=TcpTlsConfig.from_properties(properties),
                                   flush_bytes=fb, flush_micros=fm,
-                                  defer_replies=_defer_conf(properties))
+                                  defer_replies=_defer_conf(properties),
+                                  chaos=chaos)
 
     def new_client_transport(self, properties=None) -> ClientTransport:
         fb, fm = _flush_conf(properties)

@@ -34,13 +34,19 @@ def pytest_configure(config):
         "markers", "mp: spawns a real multi-process cluster (slower)")
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-scenario gate "
+                   "(ratis_tpu.chaos); fast scenarios run in tier-1, the "
+                   "long campaign also carries `slow`")
 
 
 @pytest.fixture(autouse=True)
 def _clear_injections():
     yield
+    from ratis_tpu.chaos.link import link_faults
     from ratis_tpu.util import injection
     injection.clear()
+    link_faults().heal_all()
 
 
 # ------------------------------------------------------------ task hygiene

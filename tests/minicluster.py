@@ -238,6 +238,12 @@ class MiniCluster:
         target = server_id or next(iter(self.servers))
         deadline = asyncio.get_event_loop().time() + timeout
         last_exc: Optional[Exception] = None
+        # ONE call id across every retry: an attempt that was appended by
+        # a then-deposed leader can still commit later, and only a stable
+        # (clientId, callId) lets the retry cache dedupe the re-send (a
+        # fresh id per attempt double-applied ~1/full-suite run)
+        if call_id is None:
+            call_id = next(self._call_ids)
         while asyncio.get_event_loop().time() < deadline:
             server = self.servers.get(target)
             if server is None:

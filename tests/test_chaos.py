@@ -1,137 +1,127 @@
-"""Randomized fault injection under load (the reference's closest analogs:
-RaftExceptionBaseTest, TestRaftWithSimulatedRpc kill/restart suites, and the
-leader-election churn tests — folded into one linearizability-style check).
+"""Randomized fault injection under load, ported onto the chaos scenario
+engine (ratis_tpu.chaos; reference analogs RaftExceptionBaseTest, the
+kill/restart suites over simulated RPC, and the leader-election churn
+tests).
 
-Writers drive uniquely-tagged appends through the full client path while the
-cluster suffers random partitions, leader kills, and restarts.  After
-healing, the invariants are:
+The old in-test nemesis loop is now the ``randomized_nemesis`` SCENARIO:
+a deterministic schedule derived from the seed, so a failing run is
+replayable bit-for-bit (``python -m ratis_tpu.tools.chaos_replay``) and
+every assertion carries the seed.  The old loop's kill arm also only
+fired when ``len(cluster.servers) == 3`` — silently no-opping crash
+coverage at every other cluster size; the scenario builder kills at any
+size (asserted below).
 
-1. every ACKED write is applied exactly once on every live replica
-   (retry-cache dedupe across failover means client retries must not
-   double-apply),
-2. all replicas applied the same sequence (state-machine determinism),
-3. un-acked writes appear at most once (a timed-out attempt may still have
-   committed — that's Raft; it must not appear twice).
+Invariants after healing (the engine's standing SLOs):
+
+1. every ACKED write is applied exactly once on every live replica,
+2. all replicas applied the same sequence,
+3. un-acked writes appear at most once,
+4. re-election converges within the scenario bound.
 """
 
 import asyncio
-import random
 
 import pytest
 
-from minicluster import MiniCluster, fast_properties
-from statemachines import RecordingStateMachine
+from ratis_tpu.chaos.campaign import run_campaign
+from ratis_tpu.chaos.cluster import ChaosCluster, chaos_properties
+from ratis_tpu.chaos.scenario import run_scenario
+from ratis_tpu.chaos.scenarios import build_scenario
+
+NEMESIS_CFG = {"convergence_s": 30.0, "recovery_s": 60.0,
+               "duration_s": 5.0, "writers": 4, "min_acked": 20}
 
 
-async def _chaos(cluster: MiniCluster, seed: int, duration_s: float,
-                 n_writers: int) -> None:
-    rng = random.Random(seed)
-    acked: list[bytes] = []
-    stop = asyncio.Event()
-
-    async def writer(wid: int):
-        i = 0
-        async with cluster.new_client() as client:
-            while not stop.is_set():
-                payload = f"w{wid}-{i}".encode()
-                i += 1
-                try:
-                    reply = await asyncio.wait_for(
-                        client.io().send(payload), 8.0)
-                    if reply.success:
-                        acked.append(payload)
-                except Exception:
-                    pass  # un-acked: may or may not have committed
-                await asyncio.sleep(rng.uniform(0, 0.02))
-
-    async def nemesis():
-        end = asyncio.get_event_loop().time() + duration_s
-        while asyncio.get_event_loop().time() < end:
-            await asyncio.sleep(rng.uniform(0.3, 0.8))
-            ids = list(cluster.servers)
-            if not ids:
-                continue
-            fault = rng.random()
-            if fault < 0.4 and len(cluster.servers) == 3:
-                # kill any one server, restart it shortly after
-                victim = rng.choice(ids)
-                await cluster.kill_server(victim)
-                await asyncio.sleep(rng.uniform(0.3, 0.9))
-                await cluster.restart_server(victim)
-            elif fault < 0.8:
-                # partition one node away, then heal
-                victim = rng.choice(ids)
-                others = [x for x in ids if x != victim]
-                cluster.network.partition([victim], others)
-                await asyncio.sleep(rng.uniform(0.3, 0.9))
-                cluster.network.unblock_all()
-            else:
-                # transient asymmetric blackhole
-                a, b = rng.sample(ids, 2)
-                cluster.network.block(a, b)
-                await asyncio.sleep(rng.uniform(0.2, 0.5))
-                cluster.network.unblock_all()
-
-    writers = [asyncio.create_task(writer(w)) for w in range(n_writers)]
-    await nemesis()
-    stop.set()
-    await asyncio.gather(*writers, return_exceptions=True)
-    cluster.network.unblock_all()
-
-    # heal: let replication and apply quiesce (generous: under the forced-
-    # batched CI mode a first-tick jit compile can stall recovery)
-    leader = await cluster.wait_for_leader(timeout=40.0)
-    last = leader.state.log.get_last_committed_index()
-    await cluster.wait_applied(last, timeout=45.0)
-
-    seqs = {str(d.member_id): list(d.state_machine.applied)
-            for d in cluster.divisions()}
-    # 2) replica agreement
-    first = next(iter(seqs.values()))
-    for member, seq in seqs.items():
-        assert seq == first, (
-            f"replica divergence at {member}: {len(seq)} vs {len(first)}")
-    counts = {p: first.count(p) for p in set(first)}
-    # 3) nothing applied twice
-    dupes = {p: c for p, c in counts.items() if c > 1}
-    assert not dupes, f"duplicated applies: {dupes}"
-    # 1) every acked write applied exactly once
-    missing = [p for p in acked if counts.get(p, 0) != 1]
-    assert not missing, f"lost acked writes: {missing[:10]}"
-    assert len(acked) > 20, f"chaos run acked only {len(acked)} writes"
+async def _run_nemesis(cluster: ChaosCluster, seed: int,
+                       duration_s: float = 5.0) -> None:
+    scenario = build_scenario("randomized_nemesis", seed,
+                              dict(NEMESIS_CFG, duration_s=duration_s))
+    result = await run_scenario(cluster, scenario)
+    assert result.passed, (
+        f"[seed {seed}] nemesis scenario failed: {result.error}\n"
+        f"journal: {result.journal}")
+    assert result.acked > 20, (
+        f"[seed {seed}] chaos run acked only {result.acked} writes")
 
 
+@pytest.mark.chaos
 @pytest.mark.parametrize("seed", [11, 23])
 def test_chaos_writes_survive_faults(seed):
     async def main():
-        cluster = MiniCluster(3, properties=fast_properties(),
-                              sm_factory=RecordingStateMachine)
+        cluster = ChaosCluster(3, 1)
         await cluster.start()
         try:
-            await cluster.wait_for_leader()
-            await _chaos(cluster, seed=seed, duration_s=6.0, n_writers=4)
+            await _run_nemesis(cluster, seed)
         finally:
-            cluster.network.unblock_all()
             await cluster.close()
 
     asyncio.run(main())
 
 
-def test_chaos_batched_engine(monkeypatch):
-    """Same chaos with the jitted batched engine on every tick."""
+@pytest.mark.chaos
+def test_chaos_batched_engine():
+    """Same nemesis with the jitted batched engine on every tick."""
 
     async def main():
-        from minicluster import batched_properties
-        cluster = MiniCluster(3, properties=batched_properties(),
-                              sm_factory=RecordingStateMachine)
+        p = chaos_properties(1, seed=7)
+        p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
+        cluster = ChaosCluster(3, 1, properties=p, seed=7)
         await cluster.start()
         try:
-            await cluster.wait_for_leader()
-            await _chaos(cluster, seed=7, duration_s=5.0, n_writers=3)
+            await _run_nemesis(cluster, seed=7, duration_s=4.0)
             for s in cluster.servers.values():
-                assert s.engine.metrics["batched_dispatches"] > 0
+                assert s.engine.metrics["batched_dispatches"] > 0, \
+                    "[seed 7] batched engine never dispatched"
         finally:
-            cluster.network.unblock_all()
             await cluster.close()
 
     asyncio.run(main())
+
+
+def test_nemesis_kills_at_every_cluster_size():
+    """The old nemesis silently skipped its kill arm off 3 servers; the
+    scenario builder must schedule kills for 5- and 7-server configs too
+    (checked across a seed window — the arm fires with p=0.4/round)."""
+    for servers in (3, 5, 7):
+        kills = 0
+        for seed in range(8):
+            sc = build_scenario("randomized_nemesis", seed,
+                                {"servers": servers, "duration_s": 6.0})
+            kills += sum(1 for s in sc.steps if s.op == "kill")
+            # every kill pairs with a restart (quorum is probed, never
+            # destroyed) and targets a concrete server index
+            assert sum(1 for s in sc.steps if s.op == "kill") == \
+                sum(1 for s in sc.steps if s.op == "restart"), \
+                f"[seed {seed}] unbalanced kill/restart at {servers} servers"
+            for s in sc.steps:
+                if s.op == "kill":
+                    idx = int(s.target.split(":")[1])
+                    assert 0 <= idx < servers, \
+                        f"[seed {seed}] kill target {s.target} out of range"
+        assert kills > 0, f"no kill steps across seeds at {servers} servers"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_campaign_long():
+    """The long randomized campaign: every standing scenario plus the
+    durable slow-disk fault, on one cluster, counter-oracle invariants —
+    the full chaos gate at a mid-size multi-group shape."""
+
+    async def main(tmp: str) -> dict:
+        return await run_campaign(
+            num_servers=3, num_groups=64, seed=23, sm="counter",
+            storage_root=tmp, writers=4, active_groups=16,
+            convergence_s=45.0, recovery_s=90.0,
+            extra_config={"min_acked": 20, "duration_s": 6.0})
+
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="ratis-chaos-") as tmp:
+        out = asyncio.run(main(tmp))
+    failed = {n: e for n, e in out["scenarios"].items()
+              if not e["passed"]}
+    assert not failed, (
+        f"[seed 23] campaign scenarios failed: "
+        f"{ {n: e.get('error') for n, e in failed.items()} }")
+    assert out["passed"] == out["total"] >= 7
+    assert out["fault_events"] > 0 and out["recovered_events"] > 0

@@ -1,0 +1,348 @@
+"""Chaos subsystem units + fast deterministic scenario gates: the link-
+fault shim, schedule determinism, the replay artifact contract, the
+LOG_SYNC/RUN_LOG_WORKER injection points, and shell-health fault
+surfacing."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from ratis_tpu.chaos.cluster import ChaosCluster
+from ratis_tpu.chaos.faults import Step, make_step, truncate_log_tail
+from ratis_tpu.chaos.link import LinkFaultTable, link_faults
+from ratis_tpu.chaos.scenario import run_scenario, write_artifact
+from ratis_tpu.chaos.scenarios import build_scenario, scenario_names
+from ratis_tpu.protocol.exceptions import TimeoutIOException
+
+
+# ----------------------------------------------------- link-fault table
+
+def test_link_table_wildcards_and_specificity():
+    t = LinkFaultTable()
+    t.block("s0", "s1")
+    t.set_link("s0", None, latency_ms=5)
+    assert t.is_blocked("s0", "s1")          # exact beats wildcard
+    assert not t.is_blocked("s0", "s2")      # wildcard entry: latency only
+    assert t.lookup("s0", "s2").latency_ms == 5
+    assert t.lookup("s2", "s0") is None
+    t.heal("s0", "s1")
+    assert not t.is_blocked("s0", "s1")
+    t.heal_all()
+    assert not t
+
+
+def test_link_table_partition_and_isolate():
+    t = LinkFaultTable()
+    t.partition(["s0"], ["s1", "s2"])
+    assert t.is_blocked("s0", "s1") and t.is_blocked("s1", "s0")
+    assert t.is_blocked("s0", "s2") and t.is_blocked("s2", "s0")
+    assert not t.is_blocked("s1", "s2")
+    t.heal_all()
+    t.isolate("s1")
+    assert t.is_blocked("s0", "s1") and t.is_blocked("s1", "s2")
+
+
+def test_link_gate_block_drop_latency():
+    async def main():
+        t = LinkFaultTable(seed=5)
+        t.block("a", "b")
+        with pytest.raises(TimeoutIOException):
+            await t.gate("a", "b")
+        t.heal_all()
+        # deterministic drops: same seed -> same accept/drop sequence
+        t.set_link("a", "b", drop_rate=0.5)
+        async def seq():
+            out = []
+            for _ in range(20):
+                try:
+                    await t.gate("a", "b")
+                    out.append(1)
+                except TimeoutIOException:
+                    out.append(0)
+            return out
+        t.reseed(99)
+        first = await seq()
+        t.reseed(99)
+        assert await seq() == first
+        assert 0 < sum(first) < 20  # actually drops AND passes
+        # latency actually delays
+        t.heal_all()
+        t.set_link("a", "b", latency_ms=30)
+        t0 = time.monotonic()
+        await t.gate("a", "b")
+        assert time.monotonic() - t0 >= 0.025
+    asyncio.run(main())
+
+
+def test_transports_skip_gate_unless_chaos_enabled():
+    """A production server (key unset) never consults the table: a
+    registered fault must NOT bite a chaos-disabled cluster."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from minicluster import MiniCluster, fast_properties
+
+    async def main():
+        cluster = MiniCluster(3, properties=fast_properties())
+        await cluster.start()
+        try:
+            await cluster.wait_for_leader()
+            link_faults().block(None, None)  # blackhole EVERYTHING
+            reply = await cluster.send_write()
+            assert reply.success  # the fault plane is disarmed
+        finally:
+            link_faults().heal_all()
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------ schedule determinism
+
+def test_schedules_are_seed_deterministic():
+    cfg = {"servers": 3, "duration_s": 5.0, "durable": True}
+    for name in scenario_names():
+        a = build_scenario(name, 17, cfg)
+        b = build_scenario(name, 17, cfg)
+        assert a.steps == b.steps, f"{name}: schedule not deterministic"
+        assert a.steps, f"{name}: empty schedule"
+        c = build_scenario(name, 18, cfg)
+        assert a.steps != c.steps or len(a.steps) <= 2, \
+            f"{name}: seed does not vary the schedule"
+
+
+def test_step_json_roundtrip():
+    s = make_step(1.25, "link", "follower:0", latency_ms=5.0,
+                  drop_rate=0.125)
+    assert Step.from_json(json.loads(json.dumps(s.to_json()))) == s
+
+
+def test_replay_rebuild_matches_and_detects_drift(tmp_path):
+    from ratis_tpu.chaos.scenario import ScenarioResult
+    from ratis_tpu.tools.chaos_replay import load_artifact, rebuild_scenario
+    sc = build_scenario("partition_leader", 31, {"servers": 3})
+    res = ScenarioResult(sc.name, sc.seed, passed=False, error="boom")
+    path = write_artifact(res, sc, tmp_path)
+    rebuilt = rebuild_scenario(load_artifact(str(path)))
+    assert rebuilt.steps == sc.steps  # bit-for-bit, through JSON and back
+    # a tampered/stale schedule is refused, not silently re-derived
+    artifact = json.loads(path.read_text())
+    artifact["scenario"]["steps"][0]["at_s"] += 1.0
+    path.write_text(json.dumps(artifact))
+    with pytest.raises(SystemExit):
+        rebuild_scenario(load_artifact(str(path)))
+
+
+def test_failing_scenario_writes_artifact(tmp_path):
+    """An SLO miss emits the self-contained replay artifact."""
+
+    async def main():
+        cluster = ChaosCluster(3, 1)
+        await cluster.start()
+        try:
+            # unmeetable acked floor -> deterministic failure
+            sc = build_scenario("partition_minority", 13,
+                                {"convergence_s": 20.0, "recovery_s": 30.0,
+                                 "min_acked": 10 ** 9})
+            res = await run_scenario(cluster, sc,
+                                     artifact_dir=str(tmp_path))
+            assert not res.passed
+            path = tmp_path / "chaos-partition_minority-seed13.json"
+            assert path.exists()
+            artifact = json.loads(path.read_text())
+            assert artifact["scenario"]["seed"] == 13
+            assert artifact["journal"], "journal missing from artifact"
+            from ratis_tpu.tools.chaos_replay import rebuild_scenario
+            assert rebuild_scenario(artifact).steps == sc.steps
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------- fast deterministic scenario gates
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("name", ["partition_leader", "link_degraded",
+                                  "crash_restart_leader"])
+def test_fast_scenario_gate(name):
+    """Tier-1 standing gate: one deterministic scenario per fault class
+    on a fresh 3-server cluster, all SLOs asserted by the engine."""
+
+    async def main():
+        cluster = ChaosCluster(3, 1, seed=5)
+        await cluster.start()
+        try:
+            sc = build_scenario(name, 5, {"convergence_s": 30.0,
+                                          "recovery_s": 60.0,
+                                          "min_acked": 10})
+            res = await run_scenario(cluster, sc)
+            assert res.passed, (
+                f"[seed 5] {name} failed: {res.error}\n"
+                f"journal: {res.journal}")
+            # every injected fault journaled through /events and paired
+            kinds = [e["kind"] for e in res.journal]
+            assert "injected-fault" in kinds
+            assert "fault-recovered" in kinds
+            injected = {e["fault"] for e in res.journal
+                        if e["kind"] == "injected-fault"}
+            recovered = {e["fault"] for e in res.journal
+                         if e["kind"] == "fault-recovered"}
+            assert injected <= recovered, \
+                f"[seed 5] unpaired faults: {injected - recovered}"
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_partition_bites_real_tcp_sockets():
+    """The tentpole's transport reach: the link-fault shim partitions a
+    REAL-socket (TCP) cluster, not just the simulated hub — blocked hops
+    show up in the gate metrics and the scenario still meets its SLOs."""
+
+    async def main():
+        cluster = ChaosCluster(3, 1, transport="tcp", seed=3)
+        await cluster.start()
+        try:
+            before = dict(link_faults().metrics)
+            sc = build_scenario("partition_leader", 3,
+                                {"convergence_s": 30.0, "recovery_s": 60.0,
+                                 "min_acked": 10})
+            res = await run_scenario(cluster, sc)
+            assert res.passed, f"[seed 3] tcp partition failed: {res.error}"
+            blocked = (link_faults().metrics["blocked"]
+                       - before.get("blocked", 0))
+            assert blocked > 0, "no TCP hop was ever gated"
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------- LOG_SYNC / RUN_LOG_WORKER actually bite
+
+def test_log_sync_injection_slows_flush(tmp_path):
+    """Satellite: the dormant LOG_SYNC point is now wired into the shared
+    LogWorker's flush path — a registered delay measurably slows a
+    wait_flush append."""
+    from ratis_tpu.protocol.logentry import make_transaction_entry
+    from ratis_tpu.server.log.segmented import LogWorker, SegmentedRaftLog
+    from ratis_tpu.util import injection
+
+    async def main():
+        worker_started = []
+
+        async def on_worker(local_id, _remote, *_args):
+            worker_started.append(str(local_id))
+
+        injection.put(injection.RUN_LOG_WORKER, on_worker)
+        log = SegmentedRaftLog("chaoslog", tmp_path / "current",
+                              worker=LogWorker("chaos-test"))
+        await log.open()
+        e = make_transaction_entry(1, 0, b"c" * 16, 0, b"x" * 16)
+        await log.append_entry(e, wait_flush=True)
+        assert worker_started == ["chaos-test"]  # RUN_LOG_WORKER fired
+
+        delay = 0.08
+
+        async def slow_sync(local_id, _remote, *_args):
+            await asyncio.sleep(delay)
+
+        injection.put(injection.LOG_SYNC, slow_sync)
+        t0 = time.monotonic()
+        await log.append_entry(
+            make_transaction_entry(1, 1, b"c" * 16, 1, b"y" * 16),
+                               wait_flush=True)
+        took = time.monotonic() - t0
+        assert took >= delay * 0.9, \
+            f"LOG_SYNC delay did not bite the flush path ({took:.3f}s)"
+        injection.remove(injection.LOG_SYNC)
+        t0 = time.monotonic()
+        await log.append_entry(
+            make_transaction_entry(1, 2, b"c" * 16, 2, b"z" * 16),
+                               wait_flush=True)
+        assert time.monotonic() - t0 < delay  # back to full speed
+        await log.close()
+
+    asyncio.run(main())
+
+
+def test_truncate_log_tail(tmp_path):
+    """The crash-with-lost-tail helper drops whole records and leaves a
+    structurally valid (recoverable) log behind."""
+    from ratis_tpu.protocol.logentry import make_transaction_entry
+    from ratis_tpu.server.log.segmented import LogWorker, SegmentedRaftLog
+
+    async def main():
+        d = tmp_path / "current"
+        log = SegmentedRaftLog("tlog", d, worker=LogWorker("t-test"))
+        await log.open()
+        for i in range(10):
+            await log.append_entry(
+                make_transaction_entry(1, i, b"c" * 16, i,
+                                       f"e{i}".encode()),
+                                   wait_flush=True)
+        await log.close()
+        assert truncate_log_tail(d, 3) == 3
+        log2 = SegmentedRaftLog("tlog2", d, worker=LogWorker("t-test2"))
+        await log2.open()
+        assert log2.next_index == 7          # tail gone, prefix intact
+        assert log2.get(6) is not None and log2.get(7) is None
+        await log2.close()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------- shell health fault surfacing
+
+def test_health_surfaces_active_and_unrecovered_faults(capsys):
+    """Active injected faults and unrecovered injected-fault events exit
+    1; once healed AND paired with fault-recovered, health goes green
+    again (recovered faults print as history only)."""
+    import argparse
+
+    from ratis_tpu.shell.cli import cmd_health
+
+    async def main():
+        p_extra = {"raft.tpu.metrics.http-port": "0",
+                   "raft.tpu.chaos.enabled": "true"}
+        from ratis_tpu.chaos.cluster import chaos_properties
+        props = chaos_properties(1)
+        for k, v in p_extra.items():
+            props.set(k, v)
+        cluster = ChaosCluster(3, 1, properties=props)
+        await cluster.start()
+        try:
+            await cluster.wait_for_leader()
+            endpoints = ",".join(s.metrics_http.address
+                                 for s in cluster.servers.values())
+            args = argparse.Namespace(endpoints=endpoints, timeout=10.0,
+                                      verbose=False)
+            assert await cmd_health(args) == 0
+            capsys.readouterr()
+
+            # an ACTIVE link fault degrades health even before any event
+            link_faults().set_link("s1", None, latency_ms=5)
+            assert await cmd_health(args) == 1
+            assert "ACTIVE INJECTED FAULTS" in capsys.readouterr().out
+            link_faults().heal_all()
+
+            # an unrecovered injected-fault event degrades health...
+            cluster.emit_fault_event("injected-fault", "partition s1",
+                                     fault_id="t/1/0")
+            assert await cmd_health(args) == 1
+            assert "UNRECOVERED" in capsys.readouterr().out
+            # ...until its recovery pair lands
+            cluster.emit_fault_event("fault-recovered",
+                                     "recovered: partition s1",
+                                     fault_id="t/1/0")
+            assert await cmd_health(args) == 0
+            out = capsys.readouterr().out
+            assert "(recovered)" in out
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())

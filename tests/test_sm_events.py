@@ -97,14 +97,42 @@ def test_not_leader_drains_pending_to_sm():
     to notify_not_leader before failing their futures."""
 
     async def body(cluster: MiniCluster):
-        leader = await cluster.wait_for_leader()
-        lid = leader.member_id.peer_id
-        others = [d.member_id.peer_id for d in cluster.divisions()
-                  if d.member_id.peer_id != lid]
-        cluster.network.partition([lid], others)
-        # this write reaches the isolated leader and pends forever there
-        write = asyncio.create_task(cluster.send(
-            b"INCREMENT", server_id=lid, timeout=20.0))
+        # The write must be IN the isolated leader's pending set before
+        # the staleness step-down (~400ms after the partition) drains it
+        # — the old single-shot partition-then-write order lost that race
+        # ~1/10 runs (step-down with an EMPTY pending set emits nothing).
+        # A committed sanity write right before the partition proves the
+        # leader is READY (a fresh not-ready leader rejects instead of
+        # pending), and a missed window is retried on the new leader.
+        leader = write = None
+        for _attempt in range(4):
+            leader = await cluster.wait_for_leader()
+            assert (await cluster.send(b"INCREMENT")).success  # ready
+            lid = leader.member_id.peer_id
+            others = [d.member_id.peer_id for d in cluster.divisions()
+                      if d.member_id.peer_id != lid]
+            cluster.network.partition([lid], others)
+            write = asyncio.create_task(cluster.send(
+                b"INCREMENT", server_id=lid, timeout=30.0))
+            deadline = asyncio.get_event_loop().time() + 2.0
+            pended = False
+            while asyncio.get_event_loop().time() < deadline:
+                if leader.leader_ctx is not None \
+                        and leader.leader_ctx.pending:
+                    pended = True
+                    break
+                if not leader.is_leader():
+                    break  # stepped down before the write arrived
+                await asyncio.sleep(0.02)
+            if pended:
+                break
+            # missed the window: heal, let the write land somewhere, retry
+            cluster.network.unblock_all()
+            await write
+            write = None
+        else:
+            raise AssertionError(
+                "write never pended on an isolated leader in 4 attempts")
         sm = leader.state_machine
         deadline = asyncio.get_event_loop().time() + 8.0
         while asyncio.get_event_loop().time() < deadline:

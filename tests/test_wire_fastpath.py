@@ -442,7 +442,9 @@ def test_bench_summary_line_fits_driver_window():
         snapcatch=rung(catchup_s=9999.99, installs=10240,
                        cps_before=123456.8),
         win_sweep={str(d): [123456.8, 99999.99, 0.9999]
-                   for d in (1, 4, 16)})
+                   for d in (1, 4, 16)},
+        chaos={"passed": 9, "total": 9, "worst_reelect_s": 9999.999,
+               "recovery_frac": 99.999, "fault_events": 99999})
     line = json.dumps(summary, separators=(",", ":"))
     assert len(line) < 2000, f"bench line would overflow: {len(line)} chars"
     parsed = json.loads(line)
@@ -460,4 +462,8 @@ def test_bench_summary_line_fits_driver_window():
                                           0.9999]
     assert parsed["secondary"]["win_sweep"]["16"] == [123456.8, 99999.99,
                                                       0.9999]
+    # chaos campaign rung: [passed, total, worst reelect s,
+    # recovery-throughput fraction, injected-fault event records]
+    assert parsed["secondary"]["chaos_1024"] == [9, 9, 9999.999, 99.999,
+                                                 99999]
     assert "batched_commits_per_sec" in parsed["secondary"]["grpc_1024"]
