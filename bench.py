@@ -599,6 +599,21 @@ def main() -> None:
         win_sweep[str(d)] = ({"dnf": True} if r.get("dnf") else
                              [r["commits_per_sec"], r["p99_ms"],
                               r.get("window_occupancy", 0.0)])
+    # Round-11 continuous-telemetry overhead pair, back-to-back on the
+    # headline TCP rung (same box state): the sampler + hot-group sketch
+    # ON vs the identical rung with it OFF — the <=2% bound in
+    # docs/perf.md is re-measured by every bench run, and the ON side
+    # carries the hot-group skew headline.
+    tel_on = _run_child(["--e2e-child", json.dumps(
+        {"groups": HEADLINE_GROUPS, "writes": WRITES_PER_GROUP,
+         "batched": True, "concurrency": 128, "transport": "tcp",
+         "props": {"raft.tpu.telemetry.enabled": "true",
+                   "raft.tpu.telemetry.interval": "1s"}})],
+        timeout_s=900.0, allow_dnf=True)
+    tel_off = _run_child(["--e2e-child", json.dumps(
+        {"groups": HEADLINE_GROUPS, "writes": WRITES_PER_GROUP,
+         "batched": True, "concurrency": 128, "transport": "tcp"})],
+        timeout_s=900.0, allow_dnf=True)
     # gRPC at HEADLINE scale (the reference's primary RPC stack analog):
     # batched envelopes+streams at 1024 groups; the scalar
     # per-(group,follower) unary shape is attempted at the same scale and
@@ -678,7 +693,8 @@ def main() -> None:
         grpc_s_1024=grpc_s_1024, grpc_s_256=grpc_s_256, kernel=kernel,
         kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced,
         filestore5=filestore5, readmix=readmix, snapcatch=snapcatch,
-        win_sweep=win_sweep, chaos=chaos),
+        win_sweep=win_sweep, chaos=chaos, tel_on=tel_on,
+        tel_off=tel_off),
         separators=(",", ":")))
 
 
@@ -756,7 +772,13 @@ def _write_definition() -> None:
         "commit at the headline shape (metrics/hops.py; the per-request "
         "chain measures ~2, the waterline fan-out a small fraction), "
         "append-window occupancy (peak frames in flight / envelope "
-        "slots, raft.tpu.replication.window-depth)].\n"
+        "slots, raft.tpu.replication.window-depth), the round-11 "
+        "continuous-telemetry overhead pair on the headline TCP rung "
+        "([sampler-on c/s, sampler-off c/s, overhead fraction]; "
+        "raft.tpu.telemetry.* — the <=2%% docs/perf.md bound re-measured "
+        "every run), and the headline hot-group skew (top group's "
+        "GUARANTEED share of sketched commit load, (count-err)/total; "
+        "uniform load reads ~0, genuine zipf skew the true share)].\n"
         "- secondary.win_sweep: round-9 window-depth sweep on the "
         "headline TCP rung, depth -> [commits/s, p99 ms, window "
         "occupancy]; depth 1 is the latched stop-and-wait-per-group "
@@ -803,12 +825,23 @@ def _compact_decomp(block, client=None) -> dict:
     return out
 
 
+def _tel_pair(tel_on, tel_off) -> list:
+    """[telemetry-on c/s, telemetry-off c/s, overhead fraction] — the
+    round-11 sampler-cost pair; either side DNF collapses to []."""
+    on = (tel_on or {}).get("commits_per_sec")
+    off = (tel_off or {}).get("commits_per_sec")
+    if not on or not off:
+        return []
+    return [round(on), round(off), round(1.0 - on / off, 3)]
+
+
 def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                peer5_sp, peer5_mp, peer5_scalar, peer5_grpc,
                peer5_grpc_scalar, peer7, sparse_hib, sparse_plain, churn,
                mixed, stream, grpc_b, grpc_s_1024, grpc_s_256, kernel,
                kernel_100k, tpu_e2e, traced, filestore5, readmix,
-               snapcatch, win_sweep=None, chaos=None) -> dict:
+               snapcatch, win_sweep=None, chaos=None, tel_on=None,
+               tel_off=None) -> dict:
     """Build the one-line JSON summary.  COMPACT by contract: the whole
     line must parse from the driver's 2000-char tail window (r5 lost its
     flagship number to overflow), so keys are short, numbers rounded, and
@@ -876,7 +909,16 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                     # round-9 append-window occupancy (peak frames in
                     # flight / envelope slots) at the headline shape
                     _median([t.get("window_occupancy", 0.0)
-                             for t in headline])],
+                             for t in headline]),
+                    # round-11 continuous-telemetry overhead pair on the
+                    # headline TCP rung: [sampler-on c/s, sampler-off
+                    # c/s, overhead fraction (1 - on/off)]
+                    _tel_pair(tel_on, tel_off),
+                    # headline hot-group skew: top group's share of
+                    # sketched commit load (uniform 1024-group load
+                    # reads ~1/1024; the zipf serving rung will not)
+                    ((tel_on or {}).get("telemetry", {})
+                     .get("hot_share", 0.0))],
             # window-depth sweep: depth -> [c/s, p99 ms, occupancy]
             "win_sweep": win_sweep or {},
             "scalar_mode_commits_per_sec": _median(scalar_cps),
@@ -902,7 +944,7 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
             },
             "peer5_10240_grpc": (
                 {"dnf": True,
-                 "err": str(peer5_grpc.get("reason", ""))[:60]}
+                 "err": str(peer5_grpc.get("reason", ""))[:40]}
                 if peer5_grpc.get("dnf") else {
                     "commits_per_sec": peer5_grpc["commits_per_sec"],
                     "p99": peer5_grpc["p99_ms"],
@@ -972,7 +1014,7 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
             },
             "tpu_e2e": (
                 {"dnf": True, "err": str(tpu_e2e.get(
-                    "reason", tpu_e2e.get("timeout_s", "")))[:60]}
+                    "reason", tpu_e2e.get("timeout_s", "")))[:40]}
                 if tpu_e2e.get("dnf") else
                 {"cps": tpu_e2e["commits_per_sec"],
                  "p50": tpu_e2e["p50_ms"]}),

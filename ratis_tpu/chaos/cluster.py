@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import time
 from typing import List, Optional
 
@@ -35,6 +36,8 @@ from ratis_tpu.server.statemachine import (BaseStateMachine,
                                            TransactionContext)
 from ratis_tpu.transport.simulated import (SimulatedNetwork,
                                            SimulatedTransportFactory)
+
+LOG = logging.getLogger(__name__)
 
 DEFAULT_TIMEOUT = 15.0
 
@@ -120,6 +123,16 @@ class ChaosCluster:
                            else chaos_properties(num_groups, seed=seed))
         self.properties = self.properties.clone()
         self.properties.set(RaftServerConfigKeys.Chaos.ENABLED_KEY, "true")
+        # Continuous telemetry ON for chaos clusters (unless the caller
+        # pinned it): a failing scenario attaches every server's flight
+        # recorder window to its replay artifact, so the campaign's
+        # post-mortem carries the rate history across the fault, not just
+        # the final snapshot.  Fast cadence — scenarios last seconds.
+        tk = RaftServerConfigKeys.Telemetry
+        if self.properties.get(tk.ENABLED_KEY) is None:
+            self.properties.set(tk.ENABLED_KEY, "true")
+        if self.properties.get(tk.INTERVAL_KEY) is None:
+            self.properties.set(tk.INTERVAL_KEY, "200ms")
         self.storage_root = storage_root
         if storage_root is not None:
             RaftServerConfigKeys.Log.set_use_memory(self.properties, False)
@@ -244,6 +257,19 @@ class ChaosCluster:
         for s in self.servers.values():
             if s.watchdog is not None:
                 s.watchdog.emit(kind, None, detail, fault=fault_id)
+
+    def flight_snapshots(self, reason: str) -> list[dict]:
+        """Every live server's flight-recorder window (telemetry-enabled
+        servers only) — the scenario runner attaches these to a failing
+        run's replay artifact."""
+        out = []
+        for s in self.servers.values():
+            if s.flight is not None:
+                try:
+                    out.append(s.flight.snapshot(reason))
+                except Exception:
+                    LOG.exception("flight snapshot of %s failed", s.peer_id)
+        return out
 
     # ------------------------------------------------------------ queries
 

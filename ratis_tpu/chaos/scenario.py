@@ -72,6 +72,10 @@ class ScenarioResult:
     attempts: int = 0
     baseline_cps: float = 0.0
     recovery_cps: float = 0.0
+    # flight-recorder windows (one per telemetry-enabled server),
+    # attached on failure: the samples + watchdog events spanning the
+    # fault window ride inside the replay artifact
+    flight: list = dataclasses.field(default_factory=list)
 
     @property
     def recovery_frac(self) -> float:
@@ -84,13 +88,16 @@ class ScenarioResult:
     def to_artifact(self, scenario: Scenario) -> dict:
         """Self-contained replay artifact: everything chaos_replay needs
         to re-run this scenario exactly and compare outcomes."""
-        return {"version": ARTIFACT_VERSION,
-                "scenario": scenario.to_json(),
-                "passed": self.passed, "error": self.error,
-                "slos": self.slos, "checks": self.checks,
-                "acked": self.acked, "attempts": self.attempts,
-                "recovery_frac": self.recovery_frac,
-                "journal": self.journal}
+        out = {"version": ARTIFACT_VERSION,
+               "scenario": scenario.to_json(),
+               "passed": self.passed, "error": self.error,
+               "slos": self.slos, "checks": self.checks,
+               "acked": self.acked, "attempts": self.attempts,
+               "recovery_frac": self.recovery_frac,
+               "journal": self.journal}
+        if self.flight:
+            out["flight"] = self.flight
+        return out
 
 
 def write_artifact(result: ScenarioResult, scenario: Scenario,
@@ -445,14 +452,20 @@ class ScenarioRunner:
             res.acked = writers.total_acked
             res.attempts = writers.attempts
 
-            # ------------------------------------------------ invariants
-            self._verify(writers)
+            # Recovery pairing BEFORE the invariant checks: by this point
+            # the faults healed and the recovery SLOs (convergence +
+            # catch-up) were observed, so a run that then fails a DATA
+            # invariant still journals its fault-recovered pairs — the
+            # flight recorder attached to the failure artifact must show
+            # the fault window closed, not dangling.
             for rec in [r for r in res.journal
                         if r["kind"] == KIND_INJECTED_FAULT]:
                 self._journal(KIND_FAULT_RECOVERED, None,
                               f"recovered: {rec['detail']} "
                               f"(reelect {res.slos['reelect_s']}s)",
                               fault_id=rec["fault"])
+            # ------------------------------------------------ invariants
+            self._verify(writers)
             res.passed = True
         except Exception as e:  # CancelledError (BaseException) propagates
             res.error = f"{type(e).__name__}: {e}"
@@ -542,6 +555,13 @@ async def run_scenario(cluster, scenario: Scenario,
     result = await runner.run()
     if not result.passed:
         from ratis_tpu.conf.keys import RaftServerConfigKeys
+        snap = getattr(cluster, "flight_snapshots", None)
+        if snap is not None:
+            # the telemetry window across the fault rides in the replay
+            # artifact: rates/occupancy/hot-groups + the paired
+            # injected-fault journal, not just the end state
+            result.flight = snap(
+                f"chaos-{scenario.name}-seed{scenario.seed}")
         d = artifact_dir or RaftServerConfigKeys.Chaos.artifact_dir(
             cluster.properties)
         if d:

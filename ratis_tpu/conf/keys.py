@@ -492,6 +492,69 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Watchdog.CHURN_KEY,
                 RaftServerConfigKeys.Watchdog.CHURN_DEFAULT)
 
+    class Telemetry:
+        """Continuous telemetry (ratis_tpu.metrics.timeseries /
+        ratis_tpu.metrics.flight; reference analog: the per-server
+        rate/percentile registries of ratis-metrics,
+        RaftServerMetricsImpl — operators see trends, not samples).  A
+        per-server background sampler takes registry deltas at a fixed
+        cadence into bounded ring buffers, derives rates (commits/s,
+        acks/s, rewinds/s, engine occupancy) and log2-bucket latency
+        quantiles, and tracks a space-saving top-k hot-group sketch
+        (commits + pending per group) served at ``GET /timeseries``
+        (``?since=`` incremental) and ``GET /hotgroups``.  The flight
+        recorder keeps the last window of samples + watchdog events +
+        recent trace spans and dumps a replayable JSON artifact on
+        watchdog degradation, chaos scenario failure, SIGTERM, or
+        explicit request (``GET /flightrecorder``).  With ``enabled``
+        unset (the default) no sampler task is created and every
+        request path is untouched."""
+
+        ENABLED_KEY = "raft.tpu.telemetry.enabled"
+        ENABLED_DEFAULT = False
+        INTERVAL_KEY = "raft.tpu.telemetry.interval"
+        INTERVAL_DEFAULT = TimeDuration.valueOf("1s")
+        # ring window: samples retained = window / interval (bounded)
+        WINDOW_KEY = "raft.tpu.telemetry.window"
+        WINDOW_DEFAULT = TimeDuration.valueOf("120s")
+        # space-saving sketch size: top-k hot groups tracked exactly
+        # enough (error bound <= total/k rides along in the payload)
+        HOT_GROUPS_KEY = "raft.tpu.telemetry.hot-groups"
+        HOT_GROUPS_DEFAULT = 16
+        # flight-recorder artifacts land here; "" = serve /flightrecorder
+        # on request but never write dump files on triggers
+        FLIGHT_DIR_KEY = "raft.tpu.telemetry.flight-dir"
+        FLIGHT_DIR_DEFAULT = ""
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Telemetry.ENABLED_KEY,
+                RaftServerConfigKeys.Telemetry.ENABLED_DEFAULT)
+
+        @staticmethod
+        def interval(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Telemetry.INTERVAL_KEY,
+                RaftServerConfigKeys.Telemetry.INTERVAL_DEFAULT)
+
+        @staticmethod
+        def window(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Telemetry.WINDOW_KEY,
+                RaftServerConfigKeys.Telemetry.WINDOW_DEFAULT)
+
+        @staticmethod
+        def hot_groups(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Telemetry.HOT_GROUPS_KEY,
+                RaftServerConfigKeys.Telemetry.HOT_GROUPS_DEFAULT)
+
+        @staticmethod
+        def flight_dir(p: RaftProperties) -> str:
+            return p.get(RaftServerConfigKeys.Telemetry.FLIGHT_DIR_KEY,
+                         RaftServerConfigKeys.Telemetry.FLIGHT_DIR_DEFAULT)
+
     class Chaos:
         """Chaos campaign subsystem (ratis_tpu.chaos; reference analogs:
         RaftExceptionBaseTest, the kill/restart suites over simulated RPC,

@@ -85,19 +85,43 @@ def parse_prometheus_text(text: str) -> dict:
 
 async def scrape_server(address: str, timeout_s: float = 10.0) -> dict:
     """One child's full introspection scrape: health + divisions + events
-    + parsed /metrics samples, plus the scrape address for re-scraping."""
-    health, divisions, events, metrics_text = await asyncio.gather(
+    + parsed /metrics samples, plus the scrape address for re-scraping.
+
+    Partial-failure tolerant: each route is fetched independently
+    (an earlier bare ``asyncio.gather`` let ONE failing route poison the
+    whole server's scrape).  A route that fails lands in ``errors``
+    with empty data of the right shape; only when EVERY route fails —
+    the endpoint is actually dead — does the scrape raise, so
+    :func:`scrape_cluster` classifies the server unreachable."""
+    results = await asyncio.gather(
         fetch_json(address, "/health", timeout_s),
         fetch_json(address, "/divisions", timeout_s),
         fetch_json(address, "/events", timeout_s),
-        fetch_text(address, "/metrics", timeout_s))
-    return {
+        fetch_text(address, "/metrics", timeout_s),
+        return_exceptions=True)
+    paths = ("/health", "/divisions", "/events", "/metrics")
+    empties = ({}, [], {}, "")
+    errors = {}
+    clean = []
+    for path, res, empty in zip(paths, results, empties):
+        if isinstance(res, BaseException):
+            errors[path] = str(res) or type(res).__name__
+            clean.append(empty)
+        else:
+            clean.append(res)
+    if len(errors) == len(paths):
+        raise RuntimeError(f"all routes failed: {errors['/health']}")
+    health, divisions, events, metrics_text = clean
+    out = {
         "address": address,
         "health": health,
         "divisions": divisions,
         "events": events,
         "metrics": parse_prometheus_text(metrics_text),
     }
+    if errors:
+        out["errors"] = errors
+    return out
 
 
 def _summarize_proc(scrape: dict) -> dict:
@@ -126,8 +150,12 @@ def _summarize_proc(scrape: dict) -> dict:
         "chaosActiveFaults": active_faults,
         "chaosInjections": chaos.get("activeInjections", []),
         "address": scrape.get("address"),
-        "peer": health.get("peer"),
-        "status": health.get("status"),
+        # a half-dead server (some routes down) keeps its address as the
+        # display name and reads degraded, never "ok"
+        "peer": health.get("peer") or scrape.get("address"),
+        "status": ("degraded" if scrape.get("errors")
+                   else health.get("status")),
+        "routeErrors": scrape.get("errors") or {},
         "divisions": len(divisions),
         "roles": roles,
         "pendingRequests": pending,
@@ -191,6 +219,104 @@ async def scrape_cluster(addresses: list[str],
         else:
             scrapes.append(res)
     merged = merge_cluster_snapshot(scrapes)
+    if unreachable:
+        merged["unreachable"] = unreachable
+    return merged
+
+
+# ------------------------------------------------- continuous telemetry
+
+def merge_timeseries(payloads: list[dict]) -> dict:
+    """Fold per-process ``/timeseries`` payloads into one pid-keyed view
+    (the way chrome traces already merge): per-pid latest sample + series
+    length, cluster-wide rates as the element-wise sum of each process's
+    newest sample, and the log2 latency buckets summed across processes
+    (the bucket encoding exists exactly so this merge is a plain add)."""
+    procs: dict = {}
+    rate_totals: dict = {}
+    lat_buckets: dict = {}
+    lat_total = 0
+    for p in payloads:
+        pid = str(p.get("pid", f"unknown-{len(procs)}"))
+        if pid in procs:  # co-hosted servers share a pid
+            pid = f"{pid}:{p.get('peer')}"
+        samples = p.get("samples", [])
+        last = samples[-1] if samples else {}
+        procs[pid] = {
+            "peer": p.get("peer"),
+            "seq": p.get("seq", -1),
+            "count": len(samples),
+            "interval_s": p.get("interval_s"),
+            "last": last,
+        }
+        for k, v in (last.get("rates") or {}).items():
+            rate_totals[k] = round(rate_totals.get(k, 0.0) + v, 3)
+        lat = p.get("latency") or {}
+        lat_total += lat.get("count", 0)
+        for b, c in (lat.get("buckets") or {}).items():
+            lat_buckets[b] = lat_buckets.get(b, 0) + c
+    return {"procs": procs, "rates": rate_totals,
+            "latency": {"count": lat_total, "buckets": lat_buckets}}
+
+
+def merge_hotgroups(payloads: list[dict], n: int = 16) -> dict:
+    """Fold per-process ``/hotgroups`` payloads into one cluster top-n:
+    per-group commits/err/pending summed across processes (each process
+    accounts its own replicas; the leader's commits dominate), ranked by
+    merged commit count."""
+    by_group: dict = {}
+    total = 0
+    for p in payloads:
+        total += p.get("total_commits", 0)
+        for g in p.get("groups", []):
+            e = by_group.setdefault(g["group"],
+                                    {"commits": 0, "err": 0, "pending": 0})
+            e["commits"] += g.get("commits", 0)
+            e["err"] += g.get("err", 0)
+            e["pending"] += g.get("pending", 0)
+    ranked = sorted(by_group.items(), key=lambda kv: -kv[1]["commits"])[:n]
+    return {
+        "total_commits": total,
+        "groups": [{"group": k, **v,
+                    "share": round(v["commits"] / max(1, total), 4),
+                    "share_min": round(
+                        max(0, v["commits"] - v["err"]) / max(1, total), 4)}
+                   for k, v in ranked],
+    }
+
+
+async def scrape_cluster_timeseries(addresses: list[str],
+                                    timeout_s: float = 10.0,
+                                    since: "dict | None" = None) -> dict:
+    """Scrape ``/timeseries`` + ``/hotgroups`` from every address and
+    merge (``since``: address -> last-seen seq for incremental polls).
+    Unreachable or telemetry-less endpoints degrade to an
+    ``unreachable`` entry, never an exception — same contract as
+    :func:`scrape_cluster`."""
+    async def one(addr: str):
+        path = "/timeseries"
+        if since and since.get(addr) is not None:
+            path += f"?since={since[addr]}"
+        ts = await fetch_json(addr, path, timeout_s)
+        hot = await fetch_json(addr, "/hotgroups", timeout_s)
+        return ts, hot
+
+    results = await asyncio.gather(*(one(a) for a in addresses),
+                                   return_exceptions=True)
+    ts_payloads, hot_payloads, unreachable = [], [], []
+    addr_of: dict = {}
+    for addr, res in zip(addresses, results):
+        if isinstance(res, BaseException):
+            unreachable.append({"address": addr,
+                                "error": str(res) or type(res).__name__})
+            continue
+        ts, hot = res
+        addr_of[str(ts.get("pid"))] = addr
+        ts_payloads.append(ts)
+        hot_payloads.append(hot)
+    merged = merge_timeseries(ts_payloads)
+    merged["hotgroups"] = merge_hotgroups(hot_payloads)
+    merged["addresses"] = addr_of
     if unreachable:
         merged["unreachable"] = unreachable
     return merged

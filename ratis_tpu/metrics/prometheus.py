@@ -163,7 +163,10 @@ def _as_number(value) -> Optional[float]:
 
 
 # A JSON route returns any json.dumps-able object; exceptions become 500.
-JsonRoute = Callable[[], object]
+# A route taking a parameter receives the parsed query dict
+# ({key: [values]}, urllib.parse.parse_qs) — /events?since= and
+# /timeseries?since= poll incrementally through it.
+JsonRoute = Callable[..., object]
 
 
 class MetricsHttpServer:
@@ -205,7 +208,8 @@ class MetricsHttpServer:
             await self._server.wait_closed()
             self._server = None
 
-    def _render(self, path: str) -> tuple[bytes, bytes]:
+    def _render(self, path: str,
+                query: Optional[dict] = None) -> tuple[bytes, bytes]:
         """(content-type, body) for ``path``; raises on handler bugs."""
         if path in ("/metrics", "/"):
             return (b"text/plain; version=0.0.4; charset=utf-8",
@@ -213,8 +217,14 @@ class MetricsHttpServer:
         route = self.json_routes.get(path)
         if route is None:
             raise KeyError(path)
+        import inspect
+        try:
+            takes_query = bool(inspect.signature(route).parameters)
+        except (TypeError, ValueError):
+            takes_query = False
+        payload = route(query or {}) if takes_query else route()
         return (b"application/json",
-                json.dumps(route(), default=str).encode())
+                json.dumps(payload, default=str).encode())
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -226,9 +236,12 @@ class MetricsHttpServer:
                 if line in (b"\r\n", b"\n", b""):
                     break
             parts = request_line.decode("latin-1").split()
-            path = (parts[1] if len(parts) >= 2 else "/").split("?")[0]
+            target = parts[1] if len(parts) >= 2 else "/"
+            path, _, qs = target.partition("?")
+            from urllib.parse import parse_qs
+            query = parse_qs(qs) if qs else {}
             try:
-                ctype, body = self._render(path)
+                ctype, body = self._render(path, query)
             except KeyError:
                 writer.write(b"HTTP/1.1 404 Not Found\r\n"
                              b"Content-Length: 0\r\nConnection: close\r\n\r\n")

@@ -367,6 +367,11 @@ class RaftServer:
         # unset no listener socket is ever opened.
         self.metrics_http = None
         self.watchdog = None
+        # Continuous telemetry (raft.tpu.telemetry.*): the background
+        # time-series sampler + flight recorder, created in start() only
+        # when enabled — off is zero-cost, identical paths.
+        self.telemetry = None
+        self.flight = None
         from ratis_tpu.conf.reconfiguration import ReconfigurationManager
         # live property reconfiguration (divisions register their knobs)
         self.reconfiguration = ReconfigurationManager(properties)
@@ -518,14 +523,32 @@ class RaftServer:
             from ratis_tpu.server.watchdog import StallWatchdog
             self.watchdog = StallWatchdog(self)
             self.watchdog.start()
+        json_routes = {"/health": self.health_info,
+                       "/divisions": self.divisions_info,
+                       "/events": self.watchdog_events}
+        if _K.Telemetry.enabled(self.properties):
+            from ratis_tpu.metrics.flight import (FlightRecorder,
+                                                  install_sigterm_dump)
+            from ratis_tpu.metrics.timeseries import TelemetrySampler
+            self.telemetry = TelemetrySampler(self)
+            self.telemetry.start()
+            flight_dir = _K.Telemetry.flight_dir(self.properties)
+            self.flight = FlightRecorder(self, self.telemetry,
+                                         dump_dir=flight_dir)
+            if self.watchdog is not None:
+                # organic degradation -> one debounced flight dump
+                self.watchdog.on_event = self.flight.on_watchdog_event
+            if flight_dir:
+                install_sigterm_dump(self.flight)
+            json_routes["/timeseries"] = self.telemetry.timeseries_info
+            json_routes["/hotgroups"] = self.telemetry.hotgroups_info
+            json_routes["/flightrecorder"] = \
+                self.flight.flightrecorder_info
         http_port = _K.Metrics.http_port(self.properties)
         if http_port is not None:
             from ratis_tpu.metrics.prometheus import MetricsHttpServer
             self.metrics_http = MetricsHttpServer(
-                port=http_port,
-                json_routes={"/health": self.health_info,
-                             "/divisions": self.divisions_info,
-                             "/events": self.watchdog_events})
+                port=http_port, json_routes=json_routes)
             await self.metrics_http.start()
         if self.shards is None:
             self.heartbeat_scheduler.start()
@@ -576,6 +599,13 @@ class RaftServer:
         if self.metrics_http is not None:
             await self.metrics_http.close()
             self.metrics_http = None
+        if self.telemetry is not None:
+            if self.flight is not None:
+                from ratis_tpu.metrics.flight import uninstall_sigterm_dump
+                uninstall_sigterm_dump(self.flight)
+                self.flight = None
+            await self.telemetry.close()
+            self.telemetry = None
         if self.watchdog is not None:
             await self.watchdog.close()
             self.watchdog = None
@@ -858,13 +888,23 @@ class RaftServer:
         return [div.introspect()
                 for div in list(self.divisions.values())]
 
-    def watchdog_events(self) -> dict:
-        """GET /events: the stall watchdog's bounded event journal."""
+    def watchdog_events(self, query=None) -> dict:
+        """GET /events: the stall watchdog's bounded event journal.
+        ``?since=<seq>`` serves only records newer than that monotonic
+        seq id — the flight recorder and ``shell top`` poll
+        incrementally instead of re-deduping the whole ring."""
         if self.watchdog is None:
-            return {"enabled": False, "events": []}
+            return {"enabled": False, "seq": -1, "events": []}
+        since = None
+        if query:
+            try:
+                since = int(query.get("since", [None])[0])
+            except (TypeError, ValueError):
+                since = None
         return {"enabled": True,
                 "count": self.watchdog.event_count(),
-                "events": self.watchdog.events()}
+                "seq": self.watchdog.last_seq,
+                "events": self.watchdog.events(since)}
 
     async def _run_on_division_loop(self, group_id: RaftGroupId, coro):
         """Await ``coro`` on the loop owning ``group_id``'s division; a

@@ -85,6 +85,16 @@ class StallWatchdog:
                 else keys.journal_size(p))
         self.journal: collections.deque = collections.deque(
             maxlen=max(1, size))
+        # monotonic event sequence id: lets /events?since=<seq> serve
+        # incrementally (the flight recorder and shell poll deltas
+        # instead of re-reading and re-deduping the whole ring) and
+        # survives ring wraparound — a consumer that slept through a
+        # full ring sees the gap in seq, not silent loss
+        self._next_seq = 0
+        # emit hook (flight recorder): called with each journaled record
+        # AFTER it lands; exceptions are swallowed — observability of the
+        # observability plane must not break detection
+        self.on_event = None
         self._task: Optional[asyncio.Task] = None
         self._running = False
         # group -> (last commitIndex, consecutive flat-with-pending rounds)
@@ -134,11 +144,13 @@ class StallWatchdog:
         KIND_INJECTED_FAULT event and its KIND_FAULT_RECOVERED pair is
         how consumers (shell health, chaos_replay) match them up."""
         record = {
+            "seq": self._next_seq,
             "t": round(time.time(), 3),
             "kind": kind,
             "group": group,
             "detail": detail,
         }
+        self._next_seq += 1
         if fault is not None:
             record["fault"] = fault
         self.journal.append(record)
@@ -147,10 +159,25 @@ class StallWatchdog:
             c.inc()
         LOG.warning("%s watchdog: %s%s: %s", self.server.peer_id, kind,
                     f" [{group}]" if group else "", detail)
+        cb = self.on_event
+        if cb is not None:
+            try:
+                cb(record)
+            except Exception:
+                LOG.exception("%s watchdog: on_event hook failed",
+                              self.server.peer_id)
 
-    def events(self) -> list[dict]:
-        """Journal contents, oldest first (the /events payload)."""
-        return list(self.journal)
+    def events(self, since: Optional[int] = None) -> list[dict]:
+        """Journal contents, oldest first (the /events payload);
+        ``since`` returns only records with ``seq > since``."""
+        if since is None:
+            return list(self.journal)
+        return [e for e in self.journal if e["seq"] > since]
+
+    @property
+    def last_seq(self) -> int:
+        """Newest journaled seq (-1 when nothing journaled yet)."""
+        return self._next_seq - 1
 
     def event_count(self) -> int:
         return sum(c.count for c in self.event_counters.values())

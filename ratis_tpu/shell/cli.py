@@ -303,6 +303,74 @@ async def cmd_health(args) -> int:
     return rc
 
 
+async def cmd_top(args) -> int:
+    """Live cluster view over the continuous-telemetry plane: poll every
+    server's ``GET /timeseries`` (incrementally, via ``?since=``) and
+    render per-process rates computed from successive counter deltas,
+    plus the merged hot-group leaderboard.  ``-iterations 0`` (default)
+    refreshes until interrupted; a fixed count makes it scriptable."""
+    import time as _time
+
+    from ratis_tpu.metrics.aggregate import scrape_cluster_timeseries
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints:
+        raise SystemExit("pass -endpoints host:port[,host:port...]")
+    since: dict = {}
+    prev: dict = {}          # pid -> (monotonic, cumulative totals)
+    i = 0
+    while True:
+        merged = await scrape_cluster_timeseries(
+            endpoints, timeout_s=args.timeout,
+            since=since if since else None)
+        now = _time.monotonic()
+        procs = merged.get("procs", {})
+        print(f"-- top @ {_time.strftime('%H:%M:%S')} | "
+              f"{len(procs)} process(es) | cluster "
+              + " ".join(f"{k}={v:g}"
+                         for k, v in sorted(
+                             merged.get("rates", {}).items())))
+        print(f"{'PEER':<10} {'PID':<8} {'C/S':>9} {'ACK/S':>9} "
+              f"{'REW/S':>7} {'OCC':>6} {'PEND':>6} {'DIV':>6} {'EVT':>5}")
+        for pid, proc in sorted(procs.items()):
+            addr = merged.get("addresses", {}).get(pid)
+            if addr is not None and proc.get("seq", -1) >= 0:
+                since[addr] = proc["seq"]
+            last = proc.get("last") or {}
+            totals = last.get("totals") or {}
+            rates = dict(last.get("rates") or {})
+            p = prev.get(pid)
+            if p is not None and totals:
+                # rates over OUR polling window from the cumulative
+                # counters each sample carries — true /timeseries deltas,
+                # independent of the server-side sampling cadence
+                dt = max(1e-6, now - p[0])
+                for k in ("commits", "acks", "rewinds"):
+                    if k in totals and k in p[1]:
+                        rates[f"{k}_per_s"] = round(
+                            max(0, totals[k] - p[1][k]) / dt, 1)
+            if totals:
+                prev[pid] = (now, totals)
+            print(f"{str(proc.get('peer') or '?'):<10} {pid:<8} "
+                  f"{rates.get('commits_per_s', 0):>9g} "
+                  f"{rates.get('acks_per_s', 0):>9g} "
+                  f"{rates.get('rewinds_per_s', 0):>7g} "
+                  f"{last.get('occupancy', 0):>6g} "
+                  f"{last.get('pending', 0):>6g} "
+                  f"{last.get('divisions', 0):>6g} "
+                  f"{totals.get('events', 0):>5g}")
+        hot = (merged.get("hotgroups") or {}).get("groups", [])
+        if hot:
+            print("hot groups: " + "  ".join(
+                f"{g['group']}={g['commits']}c/{g['pending']}p"
+                f"({g['share']:.0%})" for g in hot[:5]))
+        for dead in merged.get("unreachable", []):
+            print(f"  UNREACHABLE {dead['address']}: {dead['error']}")
+        i += 1
+        if args.iterations and i >= args.iterations:
+            return 0
+        await asyncio.sleep(args.interval)
+
+
 def cmd_local_raft_meta_conf(args) -> int:
     """Offline rewrite of raft-meta.conf to a new peer list (reference
     `local raftMetaConf`, used to resurrect a group whose quorum is gone)."""
@@ -408,6 +476,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-verbose", action="store_true",
                    help="also print every division's state")
     p.set_defaults(func=cmd_health)
+
+    p = sub.add_parser(
+        "top",
+        help="live per-process rate view over the telemetry plane "
+             "(raft.tpu.telemetry.enabled servers' GET /timeseries)")
+    p.add_argument("-endpoints", required=True,
+                   help="comma list of host:port metrics endpoints")
+    p.add_argument("-interval", type=float, default=2.0,
+                   help="refresh seconds")
+    p.add_argument("-iterations", type=int, default=0,
+                   help="refresh count (0 = until interrupted)")
+    p.add_argument("-timeout", type=float, default=10.0, help="seconds")
+    p.set_defaults(func=cmd_top)
 
     lo = sub.add_parser("local").add_subparsers(dest="sub", required=True)
     p = lo.add_parser("raftMetaConf")

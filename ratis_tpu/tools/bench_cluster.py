@@ -680,6 +680,15 @@ def _mp_server_main() -> None:
         # scrape and merge the per-process registries at rung end.
         properties.set("raft.tpu.metrics.http-port",
                        str(spec.get("metrics_port", 0)))
+        # Continuous telemetry in every measurement child (cheap: one
+        # 1s-cadence sampler): the parent merges the pid-keyed
+        # /timeseries + /hotgroups series at rung end the way it already
+        # merges chrome traces.
+        if spec.get("telemetry", True):
+            properties.set("raft.tpu.telemetry.enabled", "true")
+            if spec.get("telemetry_interval"):
+                properties.set("raft.tpu.telemetry.interval",
+                               spec["telemetry_interval"])
         me = peers[spec["peer_index"]]
         sm_factory = _mp_sm_factory(spec.get("sm", "counter"))
         if batched:
@@ -973,7 +982,9 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
                               trace_sample: int = 32,
                               trace_out: Optional[str] = None,
                               bringup_timeout_s: float = 900.0,
-                              load_timeout_s: float = 1200.0) -> dict:
+                              load_timeout_s: float = 1200.0,
+                              telemetry_interval: Optional[str] = None
+                              ) -> dict:
     """The cluster as N server processes + M client processes over real
     sockets; returns the same result-dict shape as :func:`run_bench` plus
     an ``mp`` block and a ``cluster_metrics`` block (every child's
@@ -1017,7 +1028,8 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
                 "peer_index": i, "peers": peer_list, "groups": gids_hex,
                 "batched": batched, "transport": transport, "sm": sm,
                 "loop_shards": loop_shards, "trace": trace,
-                "trace_sample": trace_sample}))
+                "trace_sample": trace_sample,
+                "telemetry_interval": telemetry_interval}))
         scrape_ports: list[int] = []
         for i, proc in enumerate(servers):
             started = await _mp_wait_line(proc, "MPSTARTED",
@@ -1064,13 +1076,24 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
         # Rung-end cluster scrape: merge every child's registries/health/
         # events into ONE snapshot while the servers are still alive.
         cluster_metrics = None
+        cluster_timeseries = None
         addresses = [f"127.0.0.1:{port}" for port in scrape_ports if port]
         if addresses:
-            from ratis_tpu.metrics.aggregate import scrape_cluster
+            from ratis_tpu.metrics.aggregate import (
+                scrape_cluster, scrape_cluster_timeseries)
             try:
                 cluster_metrics = await scrape_cluster(addresses)
             except Exception as e:
                 print(f"bench: cluster scrape failed: {e}",
+                      file=sys.stderr, flush=True)
+            # pid-keyed telemetry series + merged hot-group sketch; kept
+            # compact (per-pid latest sample, not the whole ring) so the
+            # rung artifact stays parseable from the tail window
+            try:
+                cluster_timeseries = await scrape_cluster_timeseries(
+                    addresses)
+            except Exception as e:
+                print(f"bench: timeseries scrape failed: {e}",
                       file=sys.stderr, flush=True)
 
         # Merged Perfetto artifact: each server child dumps its chrome
@@ -1130,6 +1153,8 @@ async def run_multiproc_bench(num_groups: int, writes_per_group: int, *,
             result["cluster_metrics"] = cluster_metrics
             result["watchdog_events"] = cluster_metrics.get(
                 "watchdog_events", 0)
+        if cluster_timeseries is not None:
+            result["cluster_timeseries"] = cluster_timeseries
         if trace and trace_out:
             result["trace_out"] = os.path.abspath(trace_out)
             result["trace_pids"] = merged_trace_pids
@@ -1331,6 +1356,26 @@ async def run_bench(num_groups: int, writes_per_group: int,
         result["watchdog_events"] = sum(
             s2.watchdog.event_count() for s2 in cluster.servers
             if s2.watchdog is not None)
+        # continuous-telemetry rung summary (raft.tpu.telemetry.enabled
+        # via extra_props): sampler coverage + cost and the hot-group
+        # skew headline (top group's share of sketched commit load — the
+        # signal ROADMAP item 4's admission control will read)
+        tel = [s2.telemetry for s2 in cluster.servers
+               if s2.telemetry is not None]
+        if tel:
+            from ratis_tpu.metrics.aggregate import merge_hotgroups
+            hot = merge_hotgroups([t.hotgroups_info() for t in tel], n=4)
+            top = hot["groups"][0] if hot["groups"] else None
+            result["telemetry"] = {
+                "samples": sum(t._samples_taken.count for t in tel),
+                "sample_cost_p99_ms": round(max(
+                    t._sample_cost.percentile_s(0.99) for t in tel)
+                    * 1e3, 3),
+                # guaranteed share of the hottest group: ~0 under
+                # uniform load, the true share under genuine skew
+                "hot_share": top["share_min"] if top else 0.0,
+                "hot_group": top["group"] if top else None,
+            }
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport

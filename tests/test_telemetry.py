@@ -1,0 +1,492 @@
+"""Continuous telemetry: the time-series sampler (rates, log2-bucket
+latency quantiles, space-saving hot-group sketch), the /timeseries +
+/hotgroups + /flightrecorder endpoints with incremental ``?since=``
+polling, the flight recorder's dump triggers (watchdog degradation,
+chaos failure, explicit request), `shell top`, the watchdog's monotonic
+event seq ids, partial-failure-tolerant cluster scrapes, and the
+mp-marked cross-process merge."""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+from minicluster import MiniCluster, fast_properties
+from ratis_tpu.metrics.timeseries import (Log2Buckets, SpaceSavingSketch,
+                                          log2_bucket)
+
+
+def _tel_properties(flight_dir=None):
+    p = fast_properties()
+    p.set("raft.tpu.metrics.http-port", "0")
+    p.set("raft.tpu.watchdog.interval", "150ms")
+    p.set("raft.tpu.telemetry.enabled", "true")
+    p.set("raft.tpu.telemetry.interval", "100ms")
+    if flight_dir is not None:
+        p.set("raft.tpu.telemetry.flight-dir", str(flight_dir))
+    return p
+
+
+# ------------------------------------------------------------ unit layer
+
+def test_space_saving_sketch_tracks_heavy_hitters_in_k_space():
+    import random
+    rng = random.Random(7)
+    s = SpaceSavingSketch(8)
+    true = {}
+    # zipf-ish stream: g0 gets half the mass, a 200-key tail the rest
+    for _ in range(20_000):
+        k = "g0" if rng.random() < 0.5 else f"g{rng.randrange(1, 200)}"
+        true[k] = true.get(k, 0) + 1
+        s.offer(k, 1)
+    assert len(s) <= 8                       # never more than k counters
+    top = s.top()
+    assert top[0]["key"] == "g0"
+    # space-saving bounds: count - err <= true <= count, err <= total/k
+    for e in top:
+        t = true.get(e["key"], 0)
+        assert e["count"] - e["err"] <= t <= e["count"]
+        assert e["err"] <= s.total / 8
+    # aux (pending depth) rides along without disturbing the counts
+    s.offer("g0", 0, aux=42)
+    assert s.top(1)[0]["aux"] == 42
+
+
+def test_log2_buckets_quantiles_within_2x():
+    b = Log2Buckets()
+    for v in (0.001,) * 50 + (0.010,) * 45 + (0.100,) * 5:
+        b.update(v)
+    snap = b.snapshot()
+    assert snap["count"] == 100
+    # log2 resolution: the reported bucket upper bound is within 2x
+    assert 0.001e3 <= snap["p50_ms"] <= 0.002e3 * 2
+    assert 0.1e3 <= snap["p99_ms"] <= 0.2e3 * 2
+    # sparse bucket encoding merges by plain addition
+    assert sum(snap["buckets"].values()) == 100
+    assert log2_bucket(0.0) == 0 and log2_bucket(1e9) == 63
+
+
+# ------------------------------------------------- live-cluster endpoints
+
+def test_timeseries_endpoint_incremental_and_hotgroups():
+    """Acceptance: /timeseries serves bounded samples with derived rates
+    and ?since= returns only newer ones; /hotgroups shows the written
+    group with the sketch's share accounting."""
+
+    async def body():
+        from ratis_tpu.metrics.aggregate import fetch_json
+        cluster = MiniCluster(3, properties=_tel_properties())
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            # let the sampler observe the fresh leadership first: a
+            # group's commit baseline anchors at first sight, so load
+            # written before that would be (correctly) unattributed
+            await asyncio.sleep(0.15)
+            for _ in range(5):
+                assert (await cluster.send_write()).success
+            await asyncio.sleep(0.45)
+            srv = cluster.servers[leader.member_id.peer_id]
+            addr = srv.metrics_http.address
+            ts = await fetch_json(addr, "/timeseries")
+            assert ts["count"] >= 3 and ts["seq"] >= 2
+            sample = ts["samples"][-1]
+            for key in ("seq", "t", "rates", "totals", "occupancy",
+                        "pending", "latency"):
+                assert key in sample, sample
+            assert sample["totals"]["commits"] >= 5
+            # rates derived from deltas: commits moved, so SOME sample in
+            # the window carries a positive commit rate
+            assert any(s["rates"]["commits_per_s"] > 0
+                       for s in ts["samples"])
+            # incremental poll: only samples newer than `since`
+            since = ts["seq"] - 2
+            inc = await fetch_json(addr, f"/timeseries?since={since}")
+            assert inc["count"] <= 2
+            assert all(s["seq"] > since for s in inc["samples"])
+            # the ring is bounded by window/interval
+            assert srv.telemetry.samples.maxlen == srv.telemetry.capacity
+
+            hot = await fetch_json(addr, "/hotgroups")
+            assert hot["tracked"] == 1 and hot["k"] >= 1
+            g = hot["groups"][0]
+            assert g["commits"] >= 5 and g["share"] == 1.0
+            assert str(leader.group_id) == g["group"]
+
+            # explicit-request flight payload over the same endpoint
+            fr = await fetch_json(addr, "/flightrecorder")
+            assert fr["reason"] == "request"
+            assert fr["samples"]
+            assert fr["hot_groups"]["groups"]
+        finally:
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+def test_sampler_survives_division_register_unregister_churn():
+    """Mirrors PR 4's scrape-during-unregister race: sampling passes
+    forced while groups register/unregister must never tear (no
+    exception, every sample well-formed, per-group bookkeeping pruned)."""
+
+    async def body():
+        from ratis_tpu.protocol.group import RaftGroup
+        from ratis_tpu.protocol.ids import RaftGroupId
+        cluster = MiniCluster(3, properties=_tel_properties())
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            srv = cluster.servers[leader.member_id.peer_id]
+            me = [p for p in cluster.group.peers
+                  if p.id == leader.member_id.peer_id]
+
+            async def churn():
+                for _ in range(6):
+                    g = RaftGroup.value_of(RaftGroupId.random_id(), me)
+                    await srv.group_add(g)
+                    await asyncio.sleep(0.01)
+                    await srv.group_remove(g.group_id)
+
+            task = asyncio.create_task(churn())
+            while not task.done():
+                s = srv.telemetry.sample()
+                assert {"seq", "rates", "totals"} <= set(s)
+                await asyncio.sleep(0.005)
+            await task
+            srv.telemetry.sample()
+            # bookkeeping pruned back to the surviving leaderships
+            leaders = sum(1 for d in srv.divisions.values()
+                          if d.is_leader())
+            assert len(srv.telemetry._last_commit) <= leaders
+        finally:
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+# -------------------------------------------- watchdog seq + /events?since
+
+def test_watchdog_event_seq_and_incremental_events_route():
+    async def body():
+        from ratis_tpu.metrics.aggregate import fetch_json
+        cluster = MiniCluster(3, properties=_tel_properties())
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            srv = cluster.servers[leader.member_id.peer_id]
+            for i in range(4):
+                srv.watchdog.emit("commit-stall", f"g{i}", f"synthetic {i}")
+            assert [e["seq"] for e in srv.watchdog.events()] == [0, 1, 2, 3]
+            assert srv.watchdog.last_seq == 3
+            assert [e["seq"] for e in srv.watchdog.events(since=1)] == [2, 3]
+            addr = srv.metrics_http.address
+            payload = await fetch_json(addr, "/events?since=1")
+            assert payload["seq"] == 3
+            assert [e["seq"] for e in payload["events"]] == [2, 3]
+            full = await fetch_json(addr, "/events")
+            assert len(full["events"]) == 4
+        finally:
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+# ------------------------------------------------ flight-recorder triggers
+
+def test_commit_stall_dumps_flight_artifact(tmp_path):
+    """Acceptance: an induced commit stall emits a flight-recorder dump
+    containing >= 5 samples spanning the fault window with the stall
+    event inside it."""
+    from ratis_tpu.util import injection
+
+    async def body():
+        p = _tel_properties(tmp_path)
+        p.set("raft.tpu.telemetry.interval", "50ms")
+        cluster = MiniCluster(3, properties=p)
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            assert (await cluster.send_write()).success
+            await asyncio.sleep(0.3)  # pre-fault samples in the ring
+            srv = cluster.servers[leader.member_id.peer_id]
+            lid = leader.member_id.peer_id
+            for s in cluster.servers.values():
+                s.engine.leadership_timeout_ms = 600_000
+            gate = asyncio.Event()
+
+            async def block(local_id, remote_id, *args):
+                await gate.wait()
+
+            injection.put(injection.APPEND_ENTRIES, block)
+            injection.put(injection.REQUEST_VOTE, block)
+            t_fault = asyncio.get_event_loop().time()
+            wtask = asyncio.create_task(
+                cluster.send(b"INCREMENT", server_id=lid, timeout=60.0))
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while asyncio.get_event_loop().time() < deadline:
+                if list(tmp_path.glob("flight-*.json")):
+                    break
+                await asyncio.sleep(0.1)
+            dumps = list(tmp_path.glob("flight-*.json"))
+            assert dumps, "no flight artifact written on commit stall"
+            art = json.loads(dumps[0].read_text())
+            assert art["reason"].startswith("watchdog-commit-stall")
+            assert art["peer"] == str(lid) and art["pid"] == os.getpid()
+            # >= 5 samples spanning the fault window: sampling continued
+            # from before the fault through the detection
+            assert len(art["samples"]) >= 5, len(art["samples"])
+            stall = [e for e in art["events"]
+                     if e["kind"] == "commit-stall"]
+            assert stall and "seq" in stall[0]
+            fault_wall = stall[0]["t"]
+            ts = [s["t"] for s in art["samples"]]
+            span = asyncio.get_event_loop().time() - t_fault
+            assert min(ts) < fault_wall, "no samples precede the stall"
+            assert max(ts) > fault_wall - span, \
+                "samples stop before the fault window"
+            # hot-group + rate history rode along
+            assert art["hot_groups"]["groups"]
+            assert all("rates" in s for s in art["samples"])
+
+            gate.set()
+            injection.clear()
+            reply = await asyncio.wait_for(wtask, 60.0)
+            assert reply.success
+        finally:
+            injection.clear()
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+def test_failing_chaos_scenario_attaches_flight(tmp_path):
+    """Acceptance: a failing chaos scenario's replay artifact carries
+    every server's flight window — >= 5 samples spanning the fault, with
+    the paired injected-fault / fault-recovered events inside."""
+    from ratis_tpu.chaos.cluster import ChaosCluster
+    from ratis_tpu.chaos.scenarios import build_scenario
+    from ratis_tpu.chaos.scenario import run_scenario
+
+    async def main():
+        cluster = ChaosCluster(3, 1)
+        await cluster.start()
+        try:
+            # unmeetable acked floor -> deterministic failure AFTER the
+            # faults healed and their recovery pairs journaled
+            sc = build_scenario("partition_minority", 13,
+                                {"convergence_s": 20.0, "recovery_s": 30.0,
+                                 "min_acked": 10 ** 9})
+            res = await run_scenario(cluster, sc,
+                                     artifact_dir=str(tmp_path))
+            assert not res.passed
+            artifact = json.loads(
+                (tmp_path / "chaos-partition_minority-seed13.json")
+                .read_text())
+            flights = artifact.get("flight")
+            assert flights and len(flights) == 3, \
+                "flight windows missing from replay artifact"
+            injected = [e for e in artifact["journal"]
+                        if e["kind"] == "injected-fault"]
+            assert injected
+            fault_wall = None
+            for f in flights:
+                kinds = {e["kind"] for e in f["events"]}
+                assert "injected-fault" in kinds, kinds
+                assert "fault-recovered" in kinds, kinds
+                # pairing by fault id inside the flight window
+                inj = {e["fault"] for e in f["events"]
+                       if e["kind"] == "injected-fault"}
+                rec = {e["fault"] for e in f["events"]
+                       if e["kind"] == "fault-recovered"}
+                assert inj <= rec, f"unpaired faults in flight: {inj - rec}"
+                fault_wall = min(e["t"] for e in f["events"])
+                assert len(f["samples"]) >= 5, len(f["samples"])
+                ts = [s["t"] for s in f["samples"]]
+                assert min(ts) <= fault_wall <= max(ts), \
+                    "samples do not span the fault window"
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------- partial-failure-tolerant scraping
+
+def test_scrape_server_tolerates_single_route_failure():
+    """One broken route (500) no longer poisons the whole server scrape;
+    the proc reads degraded and shell health exits 1 without a
+    traceback.  A fully dead endpoint still classifies unreachable."""
+
+    async def body():
+        from ratis_tpu.metrics.aggregate import (scrape_cluster,
+                                                 scrape_server)
+        from ratis_tpu.metrics.prometheus import MetricsHttpServer
+
+        def boom():
+            raise RuntimeError("injected route failure")
+
+        server = MetricsHttpServer(json_routes={
+            "/health": lambda: {"status": "ok", "peer": "sX", "pid": 1},
+            "/divisions": boom,
+            "/events": lambda: {"count": 0, "events": []},
+        })
+        await server.start()
+        try:
+            scrape = await scrape_server(server.address)
+            assert scrape["health"]["peer"] == "sX"
+            assert scrape["divisions"] == []
+            assert "/divisions" in scrape["errors"]
+
+            merged = await scrape_cluster([server.address])
+            assert merged["servers"] == 1
+            proc = next(iter(merged["procs"].values()))
+            assert proc["status"] == "degraded"
+            assert proc["routeErrors"]
+            assert merged["healthy"] == 0
+
+            # a dead endpoint is still an unreachable entry, not a raise
+            merged2 = await scrape_cluster([server.address,
+                                            "127.0.0.1:1"], timeout_s=3.0)
+            assert len(merged2["unreachable"]) == 1
+            assert merged2["unreachable"][0]["address"] == "127.0.0.1:1"
+        finally:
+            await server.close()
+
+    asyncio.run(body())
+
+
+def test_shell_health_reports_degraded_routes_exit_1(capsys):
+    async def body():
+        import argparse
+        from ratis_tpu.metrics.prometheus import MetricsHttpServer
+        from ratis_tpu.shell.cli import cmd_health
+
+        def boom():
+            raise RuntimeError("injected route failure")
+
+        server = MetricsHttpServer(json_routes={
+            "/health": boom,
+            "/divisions": lambda: [],
+            "/events": lambda: {"count": 0, "events": []},
+        })
+        await server.start()
+        try:
+            rc = await cmd_health(argparse.Namespace(
+                endpoints=server.address, timeout=5.0, verbose=False))
+            out = capsys.readouterr().out
+            assert rc == 1
+            assert "degraded" in out
+        finally:
+            await server.close()
+
+    asyncio.run(body())
+
+
+# ---------------------------------------------------- shell top rendering
+
+def _top_child_script() -> str:
+    """One child process: an in-process trio with telemetry on, a write
+    loop, its leader's endpoint printed for the parent to scrape."""
+    return """
+import asyncio, sys
+sys.path.insert(0, %r)
+from minicluster import MiniCluster, fast_properties
+
+async def main():
+    p = fast_properties()
+    p.set("raft.tpu.metrics.http-port", "0")
+    p.set("raft.tpu.telemetry.enabled", "true")
+    p.set("raft.tpu.telemetry.interval", "100ms")
+    cluster = MiniCluster(3, properties=p)
+    await cluster.start()
+    leader = await cluster.wait_for_leader()
+    srv = cluster.servers[leader.member_id.peer_id]
+    print("ENDPOINT " + srv.metrics_http.address, flush=True)
+    while True:
+        await cluster.send_write()
+        await asyncio.sleep(0.02)
+
+asyncio.run(main())
+""" % os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.mark.mp
+def test_shell_top_renders_rates_from_two_processes(capsys):
+    """Acceptance: `shell top` renders live per-process rates from >= 2
+    real processes (each child hosts its own cluster + write load)."""
+    import subprocess
+
+    async def body():
+        import argparse
+        from ratis_tpu.shell.cli import cmd_top
+        procs = []
+        endpoints = []
+        try:
+            for _ in range(2):
+                proc = subprocess.Popen(
+                    [sys.executable, "-c", _top_child_script()],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True)
+                procs.append(proc)
+            for proc in procs:
+                line = proc.stdout.readline()
+                assert line.startswith("ENDPOINT "), line
+                endpoints.append(line.split()[1])
+            await asyncio.sleep(1.0)  # let both samplers accumulate
+            rc = await cmd_top(argparse.Namespace(
+                endpoints=",".join(endpoints), interval=0.7,
+                iterations=2, timeout=10.0))
+            assert rc == 0
+        finally:
+            for proc in procs:
+                proc.kill()
+        out = capsys.readouterr().out
+        pids = {str(p.pid) for p in procs}
+        for pid in pids:
+            assert pid in out, f"pid {pid} missing from top output:\n{out}"
+        # per-process rate rows rendered, with a live commit rate on the
+        # second refresh (computed from /timeseries counter deltas)
+        assert "C/S" in out and "hot groups:" in out
+        rows = [l for l in out.splitlines()
+                if len(l.split()) >= 9 and l.split()[1] in pids]
+        assert len(rows) >= 4  # 2 processes x 2 refreshes
+        assert any(float(r.split()[2]) > 0 for r in rows[2:]), rows
+
+    asyncio.run(body())
+
+
+# ------------------------------------------- mp cross-process aggregation
+
+@pytest.mark.mp
+def test_multiproc_merged_timeseries_and_hotgroups():
+    """Acceptance: the multi-process bench parent merges pid-keyed
+    /timeseries + /hotgroups scrapes from every child into the rung
+    result."""
+    from ratis_tpu.tools.bench_cluster import run_multiproc_bench
+
+    async def body():
+        # enough writes that the fast-cadence child samplers observe
+        # commit deltas MID-load (a 2-write burst can land entirely
+        # between two samples and read as zero sketched load)
+        return await run_multiproc_bench(
+            8, 8, num_servers=3, transport="tcp", client_procs=2,
+            concurrency=8, bringup_timeout_s=420.0, load_timeout_s=300.0,
+            telemetry_interval="100ms")
+
+    out = asyncio.run(body())
+    assert out["commits"] == 64 and out["write_failures"] == 0
+    ts = out["cluster_timeseries"]
+    procs = ts["procs"]
+    assert len(procs) == 3, procs
+    assert all(pid.isdigit() for pid in procs), procs
+    # every child sampled: pid-keyed series with a latest sample carrying
+    # cumulative totals (>= 2 distinct pids is the acceptance floor)
+    sampled = [p for p in procs.values() if p["count"] > 0]
+    assert len(sampled) >= 2, procs
+    assert all(p["last"]["totals"]["commits"] >= 0 for p in sampled)
+    # cluster commit load visible in the merged hot-group accounting
+    hot = ts["hotgroups"]
+    assert hot["total_commits"] > 0
+    assert hot["groups"] and hot["groups"][0]["commits"] > 0

@@ -444,7 +444,12 @@ def test_bench_summary_line_fits_driver_window():
         win_sweep={str(d): [123456.8, 99999.99, 0.9999]
                    for d in (1, 4, 16)},
         chaos={"passed": 9, "total": 9, "worst_reelect_s": 9999.999,
-               "recovery_frac": 99.999, "fault_events": 99999})
+               "recovery_frac": 99.999, "fault_events": 99999},
+        tel_on=rung(telemetry={"samples": 99999,
+                               "sample_cost_p99_ms": 9999.999,
+                               "hot_share": 0.9999,
+                               "hot_group": "group-aabbccdd"}),
+        tel_off=rung())
     line = json.dumps(summary, separators=(",", ":"))
     assert len(line) < 2000, f"bench line would overflow: {len(line)} chars"
     parsed = json.loads(line)
@@ -457,9 +462,11 @@ def test_bench_summary_line_fits_driver_window():
     assert parsed["secondary"]["snap_1024"][1] == 10240
     # observability keys: [engine occupancy, watchdog event count,
     # reply-plane scheduling hops per commit (round-8 fan-out collapse),
-    # append-window occupancy (round-9 pipelined windows)]
-    assert parsed["secondary"]["obs"] == [0.9999, 99999 * 6, 99.999,
-                                          0.9999]
+    # append-window occupancy (round-9 pipelined windows), the round-11
+    # telemetry-on/off overhead pair, and the headline hot-group skew]
+    assert parsed["secondary"]["obs"] == [
+        0.9999, 99999 * 6, 99.999, 0.9999,
+        [123457, 123457, 0.0], 0.9999]
     assert parsed["secondary"]["win_sweep"]["16"] == [123456.8, 99999.99,
                                                       0.9999]
     # chaos campaign rung: [passed, total, worst reelect s,
