@@ -13,8 +13,9 @@ election-churn, follower-lag, stuck-lane).
 
 ``run_chaos_1024`` is the bench rung (ROADMAP open item 5): the default
 campaign at the 1024-group batched shape — where the windowed-rewind and
-packed-ack paths actually live — with durable segmented logs so the
-slow-disk fault bites a real fsync path.
+packed-ack paths actually live — with durable logs so the
+slow-disk fault bites a real fsync path (the 1024-group rung runs the
+shared interleaved store, ``raft.tpu.log.shared``).
 """
 
 from __future__ import annotations
@@ -36,7 +37,7 @@ DEFAULT_CAMPAIGN = ("partition_minority", "partition_leader",
                     "asymmetric_partition", "link_degraded",
                     "crash_restart_follower", "crash_restart_leader",
                     "leader_churn_storm", "slow_follower")
-DURABLE_EXTRA = ("slow_disk",)
+DURABLE_EXTRA = ("slow_disk", "shared_log_tail_loss")
 
 
 async def run_campaign(num_servers: int = 3, num_groups: int = 1,
@@ -179,8 +180,13 @@ async def run_chaos_1024(seed: int = 0, num_groups: int = 1024,
             # real deployment tunes; fault holds scale with it
             # (hold_scale) so partitions still outlast the timeout band
             # and re-election genuinely fires during the fault.
+            # the chaos rung runs the SHARED log plane (round 12,
+            # raft.tpu.log.shared): one interleaved segment sequence per
+            # loop shard, so slow-disk and tail-loss faults hit the one
+            # fsync stream every co-located group rides
             extra_props={"raft.server.rpc.timeout.min": "4s",
-                         "raft.server.rpc.timeout.max": "8s"},
+                         "raft.server.rpc.timeout.max": "8s",
+                         "raft.tpu.log.shared": "1"},
             extra_config={"min_acked": 50, "recovery_window_s": 8.0,
                           "hold_scale": 6.0})
     finally:

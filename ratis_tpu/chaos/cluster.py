@@ -19,7 +19,10 @@ import logging
 import time
 from typing import List, Optional
 
-from ratis_tpu.chaos.faults import find_group_current_dirs, truncate_log_tail
+from ratis_tpu.chaos.faults import (find_group_current_dirs,
+                                    find_shared_shard_dirs,
+                                    truncate_log_tail,
+                                    truncate_shared_log_tail)
 from ratis_tpu.chaos.link import link_faults
 from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys
 from ratis_tpu.models.counter import CounterStateMachine
@@ -239,6 +242,10 @@ class ChaosCluster:
             root = f"{self.storage_root}/{peer_id}"
             for current in find_group_current_dirs(root):
                 truncate_log_tail(current, truncate_tail)
+            # shared log plane (raft.tpu.log.shared): the tail lives in
+            # the per-shard interleaved segments, one chop per shard
+            for shard in find_shared_shard_dirs(root):
+                truncate_shared_log_tail(shard, truncate_tail)
         server = self._new_server(peer)
         self.servers[peer_id] = server
         await server.start()

@@ -136,3 +136,57 @@ def find_group_current_dirs(storage_root: "pathlib.Path | str"
     if not root.exists():
         return []
     return sorted(p for p in root.glob("*/current") if p.is_dir())
+
+
+# ------------------------------------------ shared log plane truncation
+
+_SH_SEALED_RE = re.compile(r"^shared_(\d+)$")
+_SH_OPEN_RE = re.compile(r"^shared_inprogress_(\d+)$")
+
+
+def find_shared_shard_dirs(storage_root: "pathlib.Path | str"
+                           ) -> list[pathlib.Path]:
+    """Every per-shard interleaved segment directory under one server's
+    storage root (``_sharedlog/shard-<k>``; raft.tpu.log.shared mode)."""
+    root = pathlib.Path(storage_root)
+    if not root.exists():
+        return []
+    return sorted(p for p in root.glob("_sharedlog/shard-*") if p.is_dir())
+
+
+def truncate_shared_log_tail(shard_dir: "pathlib.Path | str",
+                             records: int) -> int:
+    """Drop the last ``records`` records off a CLOSED server's shared
+    (interleaved) log shard on disk — the same lost-write-back-cache
+    crash as :func:`truncate_log_tail`, but against the one per-shard
+    segment sequence every co-located group appends into.  The chopped
+    tail interleaves MANY groups' entries and control records, so one
+    fault rewinds an arbitrary subset of the shard's groups at once.
+    Only whole records go — recovery sees a short stream, not a torn
+    one."""
+    from ratis_tpu.server.log.segmented import MAGIC, _REC_HDR, read_records
+    d = pathlib.Path(shard_dir)
+    segs = []
+    for f in d.iterdir():
+        m = _SH_SEALED_RE.match(f.name) or _SH_OPEN_RE.match(f.name)
+        if m:
+            segs.append((int(m.group(1)), f))
+    segs.sort()
+    removed = 0
+    for _n, path in reversed(segs):
+        if removed >= records:
+            break
+        payloads, _good = read_records(path)
+        keep = max(0, len(payloads) - (records - removed))
+        removed += len(payloads) - keep
+        if keep == 0:
+            path.unlink()
+            continue
+        data = path.read_bytes()
+        off = len(MAGIC)
+        for _ in range(keep):
+            ln, _crc = _REC_HDR.unpack_from(data, off)
+            off += _REC_HDR.size + ln
+        with open(path, "r+b") as fh:
+            fh.truncate(off)
+    return removed

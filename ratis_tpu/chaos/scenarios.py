@@ -231,6 +231,24 @@ def _randomized_nemesis(rng: random.Random, cfg: dict) -> tuple:
     return tuple(steps)
 
 
+@_scenario("shared_log_tail_loss")
+def _shared_log_tail_loss(rng: random.Random, cfg: dict) -> tuple:
+    """Round-12 shared log plane: crash a follower and chop the tail of
+    its per-shard INTERLEAVED segment sequence (raft.tpu.log.shared) —
+    one lost write-back cache rewinds an arbitrary subset of the
+    shard's groups at once, entries and control records alike.  The
+    boot scan must rebuild every hosted group from the short stream and
+    the leaders must rewind each one forward; zero acked writes lost,
+    exactly-once apply."""
+    down = _hold(cfg, round(rng.uniform(0.8, 1.5), 2))
+    t = _WARM_S + rng.uniform(0, 0.3)
+    # the chop interleaves many groups, so take a deeper tail than the
+    # per-group scenarios — every record removed hits a different group
+    tail = int(cfg.get("truncate_tail", rng.randint(8, 24)))
+    return (make_step(t, "kill", "follower:0"),
+            make_step(t + down, "restart", truncate_tail=tail))
+
+
 @_scenario("window_crash")
 def _window_crash(rng: random.Random, cfg: dict) -> tuple:
     """Round-9 window-protocol recovery: slow a follower so depth>1

@@ -812,6 +812,48 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Replication.REORDER_BUFFER_KEY,
                 RaftServerConfigKeys.Replication.REORDER_BUFFER_DEFAULT))
 
+    class TpuLog:
+        """Shared log plane (new; no reference analog — the reference gives
+        every group its own segment files).  With ``raft.tpu.log.shared``
+        on, all divisions pinned to a loop shard interleave into one
+        per-shard segment sequence so a replication sweep costs one
+        buffered write + one fsync regardless of group count.  Unset
+        keeps the per-group segmented store bit-for-bit."""
+
+        SHARED_KEY = "raft.tpu.log.shared"
+        SHARED_DEFAULT = 0
+        # Roll the interleaved segment at this size.  Larger than the
+        # per-group default (8MB): one shard file absorbs every co-hosted
+        # group's traffic.
+        SHARED_SEGMENT_SIZE_MAX_KEY = "raft.tpu.log.shared.segment.size.max"
+        SHARED_SEGMENT_SIZE_MAX_DEFAULT = "32MB"
+        # Rewrite a sealed segment once at least this fraction of its bytes
+        # is dead (tombstoned / purged / overwritten records).
+        COMPACTION_DEAD_RATIO_KEY = "raft.tpu.log.shared.compaction.dead-ratio"
+        COMPACTION_DEAD_RATIO_DEFAULT = 0.5
+
+        @staticmethod
+        def shared(p: RaftProperties) -> bool:
+            return p.get_int(
+                RaftServerConfigKeys.TpuLog.SHARED_KEY,
+                RaftServerConfigKeys.TpuLog.SHARED_DEFAULT) > 0
+
+        @staticmethod
+        def set_shared(p: RaftProperties, v: bool) -> None:
+            p.set_int(RaftServerConfigKeys.TpuLog.SHARED_KEY, 1 if v else 0)
+
+        @staticmethod
+        def shared_segment_size_max(p: RaftProperties) -> int:
+            return p.get_size(
+                RaftServerConfigKeys.TpuLog.SHARED_SEGMENT_SIZE_MAX_KEY,
+                RaftServerConfigKeys.TpuLog.SHARED_SEGMENT_SIZE_MAX_DEFAULT)
+
+        @staticmethod
+        def compaction_dead_ratio(p: RaftProperties) -> float:
+            return min(1.0, max(0.05, p.get_float(
+                RaftServerConfigKeys.TpuLog.COMPACTION_DEAD_RATIO_KEY,
+                RaftServerConfigKeys.TpuLog.COMPACTION_DEAD_RATIO_DEFAULT)))
+
     class Engine:
         """TPU batched-quorum engine knobs (new; no reference analog — this
         replaces the reference's thread-per-division daemons)."""

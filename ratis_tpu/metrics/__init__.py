@@ -22,6 +22,7 @@ from ratis_tpu.metrics.server_metrics import (DataStreamMetrics,
                                               LogWorkerMetrics,
                                               RaftServerMetrics,
                                               SegmentedRaftLogMetrics,
+                                              SharedLogMetrics,
                                               StateMachineMetrics)
 
 __all__ = [
@@ -29,8 +30,8 @@ __all__ = [
     "MetricRegistryInfo",
     "RatisMetricRegistry", "Timekeeper", "RaftServerMetrics",
     "LeaderElectionMetrics", "SegmentedRaftLogMetrics", "LogWorkerMetrics",
-    "LogAppenderMetrics", "StateMachineMetrics", "DataStreamMetrics",
-    "start_console_reporter",
+    "SharedLogMetrics", "LogAppenderMetrics", "StateMachineMetrics",
+    "DataStreamMetrics", "start_console_reporter",
 ]
 
 

@@ -108,9 +108,37 @@ class LogWorkerMetrics(_MetricsBase):
         self.flush_timer = r.timer("flushTime")
         self.flush_count = r.counter("flushCount")
         self.sync_timer = r.timer("syncTime")
+        # actual fsync() calls — flushCount is per drain batch; with many
+        # files per batch the two diverge, and syncCount/commits is the
+        # fsyncs-per-commit figure the shared log plane exists to shrink
+        self.sync_count = r.counter("syncCount")
 
     def add_queue_gauges(self, pending_supplier: Callable[[], int]) -> None:
         self.registry.gauge("numPendingIO", pending_supplier)
+
+    def add_sweep_gauge(self, supplier: Callable[[], float]) -> None:
+        """Decayed average of fsyncs issued per drain sweep (1.0 when every
+        division shares one segment file, ~N with per-group files)."""
+        self.registry.gauge("fsyncsPerSweep", supplier)
+
+
+class SharedLogMetrics(_MetricsBase):
+    """Per-shard shared-log store catalog (segment footprint, flush
+    backlog, compaction reclaim)."""
+
+    component = "log_worker"
+    name = "shared_log"
+
+    def __init__(self, member_id) -> None:
+        super().__init__(member_id)
+        r = self.registry
+        self.compaction_count = r.counter("compactionCount")
+        self.compaction_reclaimed = r.counter("compactionReclaimedBytes")
+
+    def add_store_gauges(self, bytes_supplier: Callable[[], int],
+                         pending_supplier: Callable[[], int]) -> None:
+        self.registry.gauge("sharedSegmentBytes", bytes_supplier)
+        self.registry.gauge("logPendingFlushDepth", pending_supplier)
 
 
 class SegmentedRaftLogMetrics(_MetricsBase):

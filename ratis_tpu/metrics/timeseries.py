@@ -251,7 +251,19 @@ class TelemetrySampler:
                         + rm.get("windowed_rewinds", 0)),
             "events": (srv.watchdog.event_count()
                        if srv.watchdog is not None else 0),
+            "fsyncs": self._fsync_reads(),
         }
+
+    def _fsync_reads(self) -> int:
+        """Cumulative fsync count across this server's log workers (per
+        device in memory mode, per shard for the shared durable store)."""
+        from ratis_tpu.server.log.segmented import LogWorker
+        prefix = f"{self.server.peer_id}:"
+        total = 0
+        for name, worker in list(LogWorker._instances.items()):
+            if name.startswith(prefix):
+                total += worker.sync_count
+        return total
 
     def _sample_locked(self) -> dict:
         now_mono = time.monotonic()
@@ -261,7 +273,8 @@ class TelemetrySampler:
         dt = max(1e-6, dt)
         rates = {f"{k}_per_s": round(
             max(0, counts[k] - self._last_counts.get(k, 0)) / dt, 3)
-            for k in ("commits", "acks", "rewinds", "dispatches")}
+            for k in ("commits", "acks", "rewinds", "dispatches",
+                      "fsyncs")}
         # dispatch latency over THIS interval: timer (count, sum) delta
         # feeds the windowed log2 buckets the quantiles read from
         timer = self.server.engine._m.dispatch_timer

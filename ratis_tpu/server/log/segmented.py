@@ -93,8 +93,12 @@ class LogWorker:
         from ratis_tpu.metrics import LogWorkerMetrics
         self.registry_metrics = LogWorkerMetrics(f"device-{name}")
         self.registry_metrics.add_queue_gauges(lambda: len(self._queue))
+        self.registry_metrics.add_sweep_gauge(lambda: self._sync_ewma)
         self._writes = self.registry_metrics.registry.counter("writeCount")
         self._batches = self.registry_metrics.registry.counter("batchCount")
+        # decayed fsyncs-per-drain-sweep: ~1.0 on a shared log plane,
+        # ~open-file-count with per-group segment files
+        self._sync_ewma = 0.0
 
     @property
     def metrics(self) -> dict:
@@ -102,6 +106,11 @@ class LogWorker:
         return {"flushes": self.registry_metrics.flush_count.count,
                 "writes": self._writes.count,
                 "batched": self._batches.count}
+
+    @property
+    def sync_count(self) -> int:
+        """Cumulative fsyncs issued by this worker."""
+        return self.registry_metrics.sync_count.count
 
     @classmethod
     def shared(cls, device_key: str) -> "LogWorker":
@@ -166,8 +175,13 @@ class LogWorker:
             # per-flush-batch sync injection point (reference
             # RaftServerImpl.java:1620's LOG_SYNC): a registered delay
             # here is the slow-disk fault — every group sharing this
-            # device pays it, exactly like a real degraded disk
-            await injection.execute(injection.LOG_SYNC, self.name)
+            # device pays it, exactly like a real degraded disk.  The
+            # extra arg is the batch's distinct-file count, so a handler
+            # can charge per FSYNC (per-group segments pay N, the shared
+            # plane pays 1) rather than per sweep.
+            files_n = len({id(fileobj) for fileobj, _, _ in batch})
+            await injection.execute(injection.LOG_SYNC, self.name, None,
+                                    files_n)
 
             def _do_io():
                 files = []
@@ -181,6 +195,9 @@ class LogWorker:
                     os.fsync(f.fileno())
                 self.registry_metrics.sync_timer.update(
                     time.perf_counter() - t_sync)
+                self.registry_metrics.sync_count.inc(len(files))
+                self._sync_ewma = (0.9 * self._sync_ewma + 0.1 * len(files)
+                                   if self._sync_ewma else float(len(files)))
 
             try:
                 with self.registry_metrics.flush_timer.time():

@@ -327,6 +327,9 @@ class RaftServer:
         self._transport_factory = transport_factory
         self.life_cycle = LifeCycle(f"server-{peer_id}")
         self.divisions: dict[RaftGroupId, Division] = {}
+        # Shared log plane (raft.tpu.log.shared): one interleaved store per
+        # loop shard, created on first use, refcounted by its divisions.
+        self._shared_log_stores: dict[int, object] = {}
         # Loop sharding (raft.tpu.server.loop-shards): N worker event loops
         # with every Division hash-pinned to one; None (shards=1, the
         # default) keeps the single-loop runtime with zero indirection.
@@ -745,13 +748,22 @@ class RaftServer:
             storage = RaftStorageDirectory(root, group.group_id)
             storage.format()
             storage.lock()
-            log = SegmentedRaftLog(
-                f"log-{self.peer_id}-{group.group_id}", storage.current,
-                worker=LogWorker.shared(f"{self.peer_id}:{root}"),
-                segment_size_max=RaftServerConfigKeys.Log.segment_size_max(
-                    self.properties),
-                cache_segments_max=RaftServerConfigKeys.Log
-                .segment_cache_num_max(self.properties))
+            if RaftServerConfigKeys.TpuLog.shared(self.properties):
+                from ratis_tpu.server.log.shared import SharedGroupLog
+                store = self._shared_log_store(root,
+                                               self.shard_of_group(
+                                                   group.group_id))
+                log = SharedGroupLog(
+                    f"log-{self.peer_id}-{group.group_id}",
+                    group.group_id.to_bytes(), store)
+            else:
+                log = SegmentedRaftLog(
+                    f"log-{self.peer_id}-{group.group_id}", storage.current,
+                    worker=LogWorker.shared(f"{self.peer_id}:{root}"),
+                    segment_size_max=RaftServerConfigKeys.Log
+                    .segment_size_max(self.properties),
+                    cache_segments_max=RaftServerConfigKeys.Log
+                    .segment_cache_num_max(self.properties))
         div = Division(self, group, sm, log=log, storage=storage)
         self.divisions[group.group_id] = div
         if self._gc_disciplined:
@@ -809,6 +821,30 @@ class RaftServer:
         return list(self.divisions)
 
     # ------------------------------------------------------------- routing
+
+    def _shared_log_store(self, root: str, shard: int):
+        """Get-or-create the shard's interleaved log store.  Each shard
+        gets its OWN LogWorker: worker futures are created on the
+        submitter's loop, and a shard's divisions all live on one loop, so
+        per-shard workers keep every future loop-affine (the per-group
+        store's single per-device worker would cross loops here)."""
+        store = self._shared_log_stores.get(shard)
+        if store is None:
+            from ratis_tpu.server.log.segmented import LogWorker
+            from ratis_tpu.server.log.shared import (SharedLogStore,
+                                                     shard_dir)
+            store = SharedLogStore(
+                shard_dir(root, shard),
+                LogWorker.shared(f"{self.peer_id}:{root}:shard{shard}"),
+                segment_size_max=RaftServerConfigKeys.TpuLog
+                .shared_segment_size_max(self.properties),
+                compaction_dead_ratio=RaftServerConfigKeys.TpuLog
+                .compaction_dead_ratio(self.properties),
+                name=f"sharedlog-{self.peer_id}-shard{shard}",
+                on_final_release=lambda s=shard:
+                self._shared_log_stores.pop(s, None))
+            self._shared_log_stores[shard] = store
+        return store
 
     def shard_of_group(self, group_id: RaftGroupId) -> int:
         """Loop-shard index owning ``group_id``'s division (0 unsharded)."""

@@ -1503,7 +1503,8 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
                           loop_shards: int = 1,
                           client_shards: int = 1,
                           stream_window: int = 16,
-                          extra_props: Optional[dict] = None) -> dict:
+                          extra_props: Optional[dict] = None,
+                          fsync_delay_ms: float = 0.0) -> dict:
     """BASELINE config 5 analog: filestore + DataStream mixed load.
 
     Every group runs a FileStore state machine; the bulk load is ordinary
@@ -1512,10 +1513,19 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
     subset of groups (ratis-examples filestore LoadGen's mixed mode).
     With ``num_servers``/``transport`` at config 3's 5-peer real-TCP shape
     this is the ``peer5_10240_filestore`` rung: the flagship workload
-    (FileStore SM + concurrent DataStream writes) at the flagship scale."""
+    (FileStore SM + concurrent DataStream writes) at the flagship scale.
+
+    ``fsync_delay_ms`` > 0 arms a MODELED disk at the LOG_SYNC injection
+    point: every log-worker drain sweep awaits delay x distinct-files
+    before its real I/O, charging per FSYNC like a device whose sync
+    costs that long.  On boxes whose page cache makes real fsyncs free
+    (sub-ms) this is the leg that shows the per-group vs shared-plane
+    difference in wall-clock, not just in fsync counts; the numbers are
+    reported as modeled, never as disk measurements."""
     import msgpack
 
     from ratis_tpu.client import RaftClient
+    from ratis_tpu.util import injection
 
     async with _started_cluster(num_groups, batched, sm="filestore",
                                 datastream=True, transport=transport,
@@ -1580,11 +1590,42 @@ async def run_mixed_bench(num_groups: int, writes_per_group: int,
         msg_factory = lambda: msgpack.packb(
             {"op": "write", "path": f"w{next(seq)}", "data": b"x" * 128},
             use_bin_type=True)
-        stream_task = asyncio.create_task(stream_load())
-        result = await cluster.run_load(writes_per_group, concurrency,
-                                        message_factory=msg_factory,
-                                        client_shards=client_shards)
-        await stream_task
+
+        def _fsync_total() -> int:
+            # durable rungs only (memory mode registers no log workers):
+            # cumulative fsyncs across every server's workers — per open
+            # segment file with per-group logs, per shard on the shared
+            # log plane (raft.tpu.log.shared)
+            from ratis_tpu.server.log.segmented import LogWorker
+            return sum(w.sync_count for w in LogWorker._instances.values())
+
+        if fsync_delay_ms > 0:
+            delay_s = fsync_delay_ms / 1000.0
+
+            async def _disk_model(_local_id, _remote_id, *args):
+                files_n = args[0] if args else 1
+                await asyncio.sleep(delay_s * files_n)
+
+            injection.put(injection.LOG_SYNC, _disk_model)
+        fsyncs_before = _fsync_total()
+        try:
+            stream_task = asyncio.create_task(stream_load())
+            result = await cluster.run_load(writes_per_group, concurrency,
+                                            message_factory=msg_factory,
+                                            client_shards=client_shards)
+            await stream_task
+        finally:
+            if fsync_delay_ms > 0:
+                injection.remove(injection.LOG_SYNC)
+        fsyncs = _fsync_total() - fsyncs_before
+        if fsyncs:
+            result["fsyncs"] = fsyncs
+            # per REPLICA: each commit lands one append on every peer, so
+            # the per-group store reads ~1.0 here (one fsync per append)
+            # and the shared plane ~1/sweep-batch — the "~1 -> ~1/groups"
+            # framing, not tripled by the replication factor
+            result["fsyncs_per_commit"] = round(
+                fsyncs / max(1, result["commits"] * num_servers), 4)
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"
         result["transport"] = transport

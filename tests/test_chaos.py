@@ -101,6 +101,38 @@ def test_nemesis_kills_at_every_cluster_size():
         assert kills > 0, f"no kill steps across seeds at {servers} servers"
 
 
+@pytest.mark.chaos
+def test_shared_log_tail_loss_scenario():
+    """Round-12 shared log plane: the interleaved-tail-loss scenario on a
+    multi-group cluster running raft.tpu.log.shared — one chopped shard
+    tail rewinds several groups at once; zero acked writes lost and the
+    counter oracle stays exactly-once."""
+
+    async def main(tmp: str):
+        p = chaos_properties(8, seed=31)
+        p.set("raft.tpu.log.shared", "1")
+        cluster = ChaosCluster(3, 8, properties=p, sm="counter",
+                               storage_root=tmp, seed=31)
+        await cluster.start()
+        try:
+            cfg = {"servers": 3, "groups": 8, "writers": 4,
+                   "active_groups": 8, "durable": True, "sm": "counter",
+                   "convergence_s": 30.0, "recovery_s": 60.0,
+                   "min_acked": 20}
+            scenario = build_scenario("shared_log_tail_loss", 31, cfg)
+            result = await run_scenario(cluster, scenario)
+            assert result.passed, (
+                f"[seed 31] shared tail-loss failed: {result.error}\n"
+                f"journal: {result.journal}")
+            assert result.acked > 20
+        finally:
+            await cluster.close()
+
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="ratis-chaos-sh-") as tmp:
+        asyncio.run(main(tmp))
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_chaos_campaign_long():

@@ -1,0 +1,535 @@
+"""Shared multi-group log plane tests.
+
+Coverage for ratis_tpu/server/log/shared.py: multi-group interleaving with
+one fsync per drain sweep, tombstone-based rewind (shared bytes are never
+rewritten), exact purge + sealed-segment compaction, the one-pass boot
+scan (torn tails, tombstones, purge markers), and randomized equivalence
+against the per-group segmented store on the RaftLog observables.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from ratis_tpu.protocol.exceptions import ChecksumException
+from ratis_tpu.protocol.ids import ClientId
+from ratis_tpu.protocol.logentry import make_transaction_entry
+from ratis_tpu.protocol.termindex import TermIndex
+from ratis_tpu.server.log.segmented import (MAGIC, LogWorker,
+                                            SegmentedRaftLog, read_records)
+from ratis_tpu.server.log.shared import (SharedGroupLog, SharedLogStore,
+                                         shard_dir)
+from tests.minicluster import MiniCluster, fast_properties
+
+GID_A = b"A" * 16
+GID_B = b"B" * 16
+GID_C = b"C" * 16
+
+
+def entry(term, index, size=8):
+    return make_transaction_entry(term, index, ClientId.random_id(), index,
+                                  b"x" * size)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_store(path, wname, **kw):
+    kw.setdefault("name", f"store-{wname}")
+    return SharedLogStore(path, LogWorker(wname), **kw)
+
+
+class TestSharedStoreBasics:
+    def test_multi_group_append_close_reopen(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "w1")
+            logs = [SharedGroupLog(f"g{i}", gid, store)
+                    for i, gid in enumerate((GID_A, GID_B, GID_C))]
+            for lg in logs:
+                await lg.open()
+            for i in range(20):
+                for t, lg in enumerate(logs):
+                    await lg.append_entry(entry(t + 1, i))
+            for lg in logs:
+                assert lg.flush_index == 19
+                await lg.close()
+
+            store2 = make_store(tmp_path, "w2")
+            logs2 = [SharedGroupLog(f"g{i}", gid, store2)
+                     for i, gid in enumerate((GID_A, GID_B, GID_C))]
+            for t, lg in enumerate(logs2):
+                await lg.open()
+                assert lg.next_index == 20
+                assert lg.flush_index == 19
+                assert lg.get(7).term == t + 1
+                assert lg.get_term_index(19) == TermIndex(t + 1, 19)
+            for lg in logs2:
+                await lg.close()
+
+        run(body())
+
+    def test_one_fsync_per_sweep(self, tmp_path):
+        """The point of the shared plane: a burst of appends across many
+        groups costs one fsync per worker drain, not one per group."""
+
+        async def body():
+            store = make_store(tmp_path, "wf")
+            logs = [SharedGroupLog(f"g{i}", bytes([i]) * 16, store)
+                    for i in range(16)]
+            for lg in logs:
+                await lg.open()
+            for rnd in range(5):
+                waits = [lg.append_entry(entry(1, rnd), wait_flush=True)
+                         for lg in logs]
+                await asyncio.gather(*waits)
+            w = store.worker
+            syncs = w.registry_metrics.sync_count.count
+            batches = w.metrics["batched"]
+            writes = w.metrics["writes"]
+            assert writes == 16 * 5
+            assert syncs == batches  # exactly one file fsynced per drain
+            assert syncs <= 10  # gather batches whole sweeps together
+            for lg in logs:
+                await lg.close()
+
+        run(body())
+
+    def test_segment_roll_and_recovery(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "wr", segment_size_max=512)
+            lg = SharedGroupLog("g", GID_A, store)
+            await lg.open()
+            for i in range(40):
+                await lg.append_entry(entry(1, i, size=32))
+            await lg.close()
+            names = sorted(p.name for p in tmp_path.iterdir())
+            sealed = [n for n in names if n.startswith("shared_")
+                      and "inprogress" not in n]
+            assert len(sealed) >= 2, names
+
+            store2 = make_store(tmp_path, "wr2")
+            lg2 = SharedGroupLog("g", GID_A, store2)
+            await lg2.open()
+            assert lg2.next_index == 40
+            assert all(lg2.get(i) is not None for i in range(40))
+            await lg2.close()
+
+        run(body())
+
+    def test_rewind_is_logical_shared_bytes_never_rewritten(self, tmp_path):
+        """Follower rewind appends a tombstone; the interleaved file only
+        grows, so other groups' records are never rewritten."""
+
+        async def body():
+            store = make_store(tmp_path, "wt")
+            la = SharedGroupLog("ga", GID_A, store)
+            lb = SharedGroupLog("gb", GID_B, store)
+            await la.open()
+            await lb.open()
+            for i in range(10):
+                await la.append_entry(entry(1, i))
+                await lb.append_entry(entry(1, i))
+            open_seg = next(p for p in tmp_path.iterdir()
+                            if p.name.startswith("shared_inprogress_"))
+            size_before = open_seg.stat().st_size
+            await la.truncate(4)
+            assert open_seg.stat().st_size > size_before  # grew, not shrank
+            assert la.next_index == 4
+            for i in range(4, 8):
+                await la.append_entry(entry(2, i))
+            # B untouched by A's rewind
+            assert lb.next_index == 10 and lb.get(9).term == 1
+            await la.close()
+            await lb.close()
+
+            store2 = make_store(tmp_path, "wt2")
+            la2 = SharedGroupLog("ga", GID_A, store2)
+            lb2 = SharedGroupLog("gb", GID_B, store2)
+            await la2.open()
+            await lb2.open()
+            assert la2.next_index == 8
+            assert la2.get(3).term == 1 and la2.get(5).term == 2
+            assert lb2.next_index == 10
+            await la2.close()
+            await lb2.close()
+
+        run(body())
+
+    def test_torn_final_record_truncated_on_boot_scan(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "wc")
+            la = SharedGroupLog("ga", GID_A, store)
+            lb = SharedGroupLog("gb", GID_B, store)
+            await la.open()
+            await lb.open()
+            for i in range(5):
+                await la.append_entry(entry(1, i))
+                await lb.append_entry(entry(1, i))
+            await la.append_entry(entry(1, 5))  # the record we will tear
+            await la.close()
+            await lb.close()
+            open_seg = next(p for p in tmp_path.iterdir()
+                            if p.name.startswith("shared_inprogress_"))
+            with open(open_seg, "r+b") as f:
+                f.truncate(open_seg.stat().st_size - 3)  # torn mid-record
+
+            store2 = make_store(tmp_path, "wc2")
+            la2 = SharedGroupLog("ga", GID_A, store2)
+            lb2 = SharedGroupLog("gb", GID_B, store2)
+            await la2.open()
+            await lb2.open()
+            assert la2.next_index == 5  # torn tail dropped for its owner...
+            assert lb2.next_index == 5  # ...other groups fully intact
+            await la2.append_entry(entry(1, 5))
+            assert la2.next_index == 6
+            await la2.close()
+            await lb2.close()
+
+        run(body())
+
+    def test_corrupt_sealed_segment_raises(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "ws", segment_size_max=256)
+            lg = SharedGroupLog("g", GID_A, store)
+            await lg.open()
+            for i in range(30):
+                await lg.append_entry(entry(1, i, size=32))
+            await lg.close()
+            sealed = sorted(p for p in tmp_path.iterdir()
+                            if p.name.startswith("shared_")
+                            and "inprogress" not in p.name)[0]
+            with open(sealed, "r+b") as f:
+                f.truncate(sealed.stat().st_size - 3)
+
+            store2 = make_store(tmp_path, "ws2")
+            lg2 = SharedGroupLog("g", GID_A, store2)
+            with pytest.raises(ChecksumException):
+                await lg2.open()
+
+        run(body())
+
+    def test_snapshot_boundary_round_trip(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "wb")
+            lg = SharedGroupLog("g", GID_A, store)
+            await lg.open()
+            lg.set_snapshot_boundary(TermIndex(2, 100))
+            assert lg.next_index == 101
+            assert lg.start_index == 101
+            assert lg.get_last_entry_term_index() == TermIndex(2, 100)
+            await lg.append_entry(entry(2, 101))
+            await lg.close()
+
+            store2 = make_store(tmp_path, "wb2")
+            lg2 = SharedGroupLog("g", GID_A, store2)
+            await lg2.open()
+            assert lg2.start_index == 101
+            assert lg2.get(101) is not None
+            await lg2.close()
+
+        run(body())
+
+    def test_eviction_reads_through_file(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "we")
+            lg = SharedGroupLog("g", GID_A, store)
+            await lg.open()
+            for i in range(30):
+                await lg.append_entry(entry(1, i, size=64))
+            n = lg.evict_cache(29)
+            assert n == 30
+            misses0 = lg.metrics.cache_miss_count.count
+            for i in range(30):
+                e = lg.get(i)
+                assert e is not None and e.index == i
+            assert lg.metrics.cache_miss_count.count == misses0 + 30
+            await lg.close()
+
+        run(body())
+
+
+class TestCompaction:
+    def test_purge_triggers_compaction_and_reclaims(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "wp", segment_size_max=2048,
+                               compaction_dead_ratio=0.3)
+            la = SharedGroupLog("ga", GID_A, store)
+            lb = SharedGroupLog("gb", GID_B, store)
+            await la.open()
+            await lb.open()
+            for i in range(60):
+                await la.append_entry(entry(1, i, size=64))
+                await lb.append_entry(entry(1, i, size=64))
+            sealed_before = dict(store._sizes)
+            assert sealed_before  # several sealed segments
+            await la.purge(49)
+            assert la.start_index == 50
+            for _ in range(50):
+                if store._compact_task is None or store._compact_task.done():
+                    break
+                await asyncio.sleep(0.02)
+            if store._compact_task is not None:
+                await store._compact_task
+            reclaimed = store.metrics.compaction_reclaimed.count
+            assert reclaimed > 0
+            # survivors still served, from compacted files included
+            la.evict_cache(60)
+            lb.evict_cache(60)
+            assert all(la.get(i) is not None for i in range(50, 60))
+            assert all(lb.get(i) is not None for i in range(60))
+            await la.close()
+            await lb.close()
+
+            # and the rewritten segment sequence recovers cleanly
+            store2 = make_store(tmp_path, "wp2")
+            la2 = SharedGroupLog("ga", GID_A, store2)
+            lb2 = SharedGroupLog("gb", GID_B, store2)
+            await la2.open()
+            await lb2.open()
+            assert la2.start_index == 50 and la2.next_index == 60
+            assert lb2.start_index == 0 and lb2.next_index == 60
+            assert lb2.get(5).index == 5
+            await la2.close()
+            await lb2.close()
+
+        run(body())
+
+    def test_compaction_under_concurrent_appends(self, tmp_path):
+        async def body():
+            store = make_store(tmp_path, "wcc", segment_size_max=1024,
+                               compaction_dead_ratio=0.3)
+            la = SharedGroupLog("ga", GID_A, store)
+            lb = SharedGroupLog("gb", GID_B, store)
+            await la.open()
+            await lb.open()
+            for i in range(40):
+                await la.append_entry(entry(1, i, size=48))
+                await lb.append_entry(entry(1, i, size=48))
+
+            stop = asyncio.Event()
+
+            async def writer():
+                i = 40
+                while not stop.is_set():
+                    await lb.append_entry(entry(1, i, size=48))
+                    i += 1
+                    await asyncio.sleep(0)
+                return i
+
+            task = asyncio.create_task(writer())
+            await la.purge(35)  # makes sealed segments mostly dead
+            for _ in range(100):
+                if store._compact_task is not None \
+                        and store._compact_task.done():
+                    break
+                await asyncio.sleep(0.01)
+            stop.set()
+            last_b = await task
+            if store._compact_task is not None:
+                await store._compact_task
+            assert store.metrics.compaction_count.count >= 1
+            assert all(la.get(i) is not None for i in range(36, 40))
+            assert all(lb.get(i) is not None for i in range(last_b))
+            await la.close()
+            await lb.close()
+
+            store2 = make_store(tmp_path, "wcc2")
+            lb2 = SharedGroupLog("gb", GID_B, store2)
+            la2 = SharedGroupLog("ga", GID_A, store2)
+            await lb2.open()
+            await la2.open()
+            assert lb2.next_index == last_b
+            assert la2.start_index == 36 and la2.next_index == 40
+            await lb2.close()
+            await la2.close()
+
+        run(body())
+
+
+class TestEquivalence:
+    """Randomized append/rewind/purge sequences replayed through BOTH
+    stores must expose identical RaftLog observables.  (Purge is the one
+    legal divergence: the per-group store purges at segment granularity,
+    the shared store purges exactly — so shared's start_index may run
+    ahead of segmented's and reads compare only above the higher.)"""
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_randomized_observable_equivalence(self, tmp_path, seed):
+        async def body():
+            rng = random.Random(seed)
+            store = make_store(tmp_path / "shared", f"weq{seed}",
+                               segment_size_max=1024)
+            pairs = []
+            for i, gid in enumerate((GID_A, GID_B)):
+                seg = SegmentedRaftLog(
+                    f"seg{i}", tmp_path / f"pg{i}",
+                    worker=LogWorker(f"weqpg{seed}{i}"), segment_size_max=1024)
+                sh = SharedGroupLog(f"sh{i}", gid, store)
+                await seg.open()
+                await sh.open()
+                pairs.append((seg, sh))
+
+            term = 1
+            for step in range(120):
+                seg, sh = pairs[rng.randrange(len(pairs))]
+                op = rng.random()
+                nxt = sh.next_index
+                if op < 0.70 or nxt == 0:
+                    e = entry(term, nxt, size=rng.choice((8, 40, 120)))
+                    await seg.append_entry(e, wait_flush=True)
+                    await sh.append_entry(e, wait_flush=True)
+                elif op < 0.85:
+                    term += 1
+                    cut = rng.randrange(max(sh.start_index, 1), nxt + 1)
+                    if cut < nxt:
+                        await seg.truncate(cut)
+                        await sh.truncate(cut)
+                elif nxt > sh.start_index:
+                    cut = rng.randrange(sh.start_index, nxt)
+                    await seg.purge(cut)
+                    await sh.purge(cut)
+                assert sh.next_index == seg.next_index
+                assert sh.flush_index == seg.flush_index
+
+            def check(seg, sh):
+                assert sh.next_index == seg.next_index
+                assert sh.flush_index == seg.flush_index
+                assert sh.start_index >= seg.start_index
+                lo = max(sh.start_index, seg.start_index)
+                for i in range(lo, sh.next_index):
+                    es, eh = seg.get(i), sh.get(i)
+                    assert es is not None and eh is not None, i
+                    assert es.term == eh.term and es.index == eh.index
+                    assert seg.get_term_index(i) == sh.get_term_index(i)
+                tis, tih = (seg.get_last_entry_term_index(),
+                            sh.get_last_entry_term_index())
+                assert (tis is None) == (tih is None)
+                if tis is not None:
+                    assert tis == tih
+
+            for seg, sh in pairs:
+                check(seg, sh)
+                await seg.close()
+                await sh.close()
+
+            # both recover to the same observables
+            store2 = make_store(tmp_path / "shared", f"weq{seed}b",
+                               segment_size_max=1024)
+            for i, gid in enumerate((GID_A, GID_B)):
+                seg = SegmentedRaftLog(
+                    f"seg{i}", tmp_path / f"pg{i}",
+                    worker=LogWorker(f"weqpg{seed}{i}b"),
+                    segment_size_max=1024)
+                sh = SharedGroupLog(f"sh{i}", gid, store2)
+                await seg.open()
+                await sh.open()
+                check(seg, sh)
+                await seg.close()
+                await sh.close()
+
+        run(body())
+
+
+class TestSharedDurableCluster:
+    def _props(self):
+        from ratis_tpu.conf import RaftServerConfigKeys
+        p = fast_properties()
+        RaftServerConfigKeys.Log.set_use_memory(p, False)
+        RaftServerConfigKeys.TpuLog.set_shared(p, True)
+        return p
+
+    def test_full_cluster_restart_preserves_state(self, tmp_path):
+        async def body():
+            cluster = MiniCluster(3, properties=self._props(),
+                                  storage_root=str(tmp_path))
+            await cluster.start()
+            try:
+                await cluster.wait_for_leader()
+                for _ in range(5):
+                    assert (await cluster.send_write()).success
+                # the interleaved store is in use, per-shard under the root
+                # (the server roots storage at <dir>/<peer_id>, and the
+                # cluster's dir is already <tmp>/<peer_id>)
+                some_root = next(iter(cluster.servers))
+                assert shard_dir(
+                    f"{tmp_path}/{some_root}/{some_root}", 0).exists()
+                for pid in list(cluster.servers):
+                    await cluster.kill_server(pid)
+                for pid in list(cluster._stopped):
+                    await cluster.restart_server(pid)
+                await cluster.wait_for_leader()
+                reply = await cluster.send_read()
+                assert reply.message.content == b"5"
+                assert (await cluster.send_write()).message.content == b"6"
+            finally:
+                await cluster.close()
+
+        run(body())
+
+    def test_follower_crash_recovers_from_shared_scan(self, tmp_path):
+        async def body():
+            cluster = MiniCluster(3, properties=self._props(),
+                                  storage_root=str(tmp_path))
+            await cluster.start()
+            try:
+                await cluster.wait_for_leader()
+                follower = next(d for d in cluster.divisions()
+                                if not d.is_leader())
+                fid = follower.member_id.peer_id
+                await cluster.kill_server(fid)
+                for _ in range(10):
+                    assert (await cluster.send_write()).success
+                await cluster.restart_server(fid)
+                new_div = cluster.servers[fid].divisions[
+                    cluster.group.group_id]
+                last = (await cluster.wait_for_leader()).state.log \
+                    .get_last_committed_index()
+                await cluster.wait_applied(last, divisions=[new_div],
+                                           timeout=20.0)
+                assert new_div.state_machine.counter == 10
+            finally:
+                await cluster.close()
+
+        run(body())
+
+    def test_unset_key_keeps_per_group_layout(self, tmp_path):
+        """raft.tpu.log.shared unset → per-group segment files, no
+        _sharedlog directory anywhere (bit-for-bit today's store)."""
+
+        async def body():
+            cluster = MiniCluster(3, storage_root=str(tmp_path))
+            await cluster.start()
+            try:
+                await cluster.wait_for_leader()
+                for _ in range(3):
+                    assert (await cluster.send_write()).success
+                assert not list(tmp_path.glob("*/*/_sharedlog"))
+                gid = cluster.group.group_id
+                per_group = list(
+                    tmp_path.glob(f"*/*/{gid.uuid}/current/log_*"))
+                assert per_group
+            finally:
+                await cluster.close()
+
+        run(body())
+
+        async def body_shared():
+            cluster = MiniCluster(3, properties=self._props(),
+                                  storage_root=str(tmp_path / "sh"))
+            await cluster.start()
+            try:
+                await cluster.wait_for_leader()
+                for _ in range(3):
+                    assert (await cluster.send_write()).success
+                assert list(
+                    (tmp_path / "sh").glob("*/*/_sharedlog/shard-*"))
+                gid = cluster.group.group_id
+                assert not list((tmp_path / "sh")
+                                .glob(f"*/*/{gid.uuid}/current/log_*"))
+            finally:
+                await cluster.close()
+
+        run(body_shared())

@@ -427,6 +427,14 @@ def test_bench_summary_line_fits_driver_window():
         sparse_hib=rung(hibernated_groups=10240), sparse_plain=rung(),
         churn=rung(transfers_ok=64, transfers_failed=64),
         mixed=rung(streams_ok=32, stream_mb_per_s=99999.99),
+        mixed_fs={"pergroup": rung(stream_mb_per_s=99999.99,
+                                   fsyncs_per_commit=99.9999),
+                  "shared": rung(stream_mb_per_s=99999.99,
+                                 fsyncs_per_commit=99.9999),
+                  "pergroup_5ms": rung(stream_mb_per_s=99999.99,
+                                       fsyncs_per_commit=99.9999),
+                  "shared_5ms": rung(stream_mb_per_s=99999.99,
+                                     fsyncs_per_commit=99.9999)},
         stream=rung(stream_mb_per_s=99999.99),
         grpc_b=trials[:3], grpc_s_1024=rung(), grpc_s_256=rung(),
         kernel={"group_updates_per_sec": 1330708656.5,
@@ -455,9 +463,13 @@ def test_bench_summary_line_fits_driver_window():
     parsed = json.loads(line)
     assert parsed["value"] == 123456.8
     assert parsed["vs_baseline"] == 1.0
-    assert parsed["secondary"]["peer5_10240"]["vs_scalar"] == 1.0
-    assert parsed["secondary"]["peer5_10240"]["mp"] == [5, 3, 4]
+    assert parsed["secondary"]["p5_10240"]["vs_scalar"] == 1.0
+    assert parsed["secondary"]["p5_10240"]["mp"] == [5, 3, 4]
     assert parsed["secondary"]["p5_fs"][2] == 32
+    # durable mixed rung: [pg c/s, pg f/c, shared c/s, shared MB/s,
+    # shared f/c, speedup] + the modeled-disk pair [pg, shared, speedup]
+    assert parsed["secondary"]["mix_fs"][5] == 1.0
+    assert parsed["secondary"]["mix_5ms"][2] == 1.0
     assert parsed["secondary"]["readmix"][1] == 123456.8
     assert parsed["secondary"]["snap_1024"][1] == 10240
     # observability keys: [engine occupancy, watchdog event count,
@@ -471,6 +483,6 @@ def test_bench_summary_line_fits_driver_window():
                                                       0.9999]
     # chaos campaign rung: [passed, total, worst reelect s,
     # recovery-throughput fraction, injected-fault event records]
-    assert parsed["secondary"]["chaos_1024"] == [9, 9, 9999.999, 99.999,
+    assert parsed["secondary"]["chaos"] == [9, 9, 9999.999, 99.999,
                                                  99999]
-    assert "batched_commits_per_sec" in parsed["secondary"]["grpc_1024"]
+    assert "cps" in parsed["secondary"]["grpc_1024"]
