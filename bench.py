@@ -366,6 +366,29 @@ def child_readmix() -> None:
     asyncio.run(main())
 
 
+def child_zipf() -> None:
+    """Zipf client-fleet rung (round-13 serving plane): 10240 logical
+    client connections with zipf(1.1)-skewed home groups over 1024
+    groups, admission control ON with the pending budget below the
+    offered concurrency — writes/s + linearizable reads/s actually
+    served, shed fraction (typed overload replies, retry-after honored),
+    p99 under overload vs an unsaturated baseline, peak pending
+    occupancy, hot-group sketch vs the analytic zipf share
+    (run_zipf_fleet_bench)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_zipf_fleet_bench
+
+    async def main():
+        out = await run_zipf_fleet_bench(1024, clients=10240,
+                                         concurrency=512,
+                                         transport="tcp")
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
 def child_snapcatch() -> None:
     """InstallSnapshot-under-load rung at 1024 groups (VERDICT Missing
     #5): snapshot+purge the leaders, wipe one server's replicas, measure
@@ -720,6 +743,11 @@ def main() -> None:
                          allow_dnf=True)
     snapcatch = _run_child(["--snapcatch-child"], timeout_s=1200.0,
                            allow_dnf=True)
+    # Round-12 serving plane: the zipf client-fleet rung — 10k+ logical
+    # clients, skewed group popularity, admission control shedding with
+    # typed replies while the served tail stays bounded.
+    zipf = _run_child(["--zipf-child"], timeout_s=1800.0,
+                      allow_dnf=True)
     # Chaos campaign rung (ROADMAP item 5): correctness-under-stress as
     # a measured artifact at the 1024-group batched shape.
     chaos = _run_child(["--chaos-child"], timeout_s=1800.0,
@@ -748,7 +776,7 @@ def main() -> None:
         kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced,
         filestore5=filestore5, readmix=readmix, snapcatch=snapcatch,
         win_sweep=win_sweep, chaos=chaos, tel_on=tel_on,
-        tel_off=tel_off),
+        tel_off=tel_off, zipf=zipf),
         separators=(",", ":")))
 
 
@@ -792,6 +820,19 @@ def _write_definition() -> None:
         "- secondary.readmix: 1024-group read/write mix over TCP "
         "(LINEARIZABLE + leader lease): [writes/s, reads/s, read p99 ms, "
         "lease-leader reads, follower readIndex reads, stale reads].\n"
+        "- secondary.zipf: round-13 serving-plane fleet rung — 10240 "
+        "logical client connections, home groups zipf(1.1)-skewed over "
+        "1024 groups (TCP, LINEARIZABLE + lease), admission control ON "
+        "(raft.tpu.serving.admission.*) with the pending budget below "
+        "the offered concurrency: [writes/s served, linearizable "
+        "reads/s served, shed fraction (typed RESOURCE_EXHAUSTED-style "
+        "replies at intake / everything that reached intake; clients "
+        "honor the retry-after hint), p99 write ms under overload "
+        "(including shed-retry time)].  The rung's own RESULT record "
+        "additionally carries the overload-p99 / unsaturated-p99 ratio "
+        "(acceptance bound <= 5), peak pending-budget occupancy, "
+        "confirmation sweeps per linearizable read, and the hot-group "
+        "sketch share of the top group vs the analytic zipf share.\n"
         "- secondary.snap_1024: wipe one server's replicas at 1024 "
         "groups, chunked snapshot install catch-up under live writes: "
         "[catchup s, installs, commits/s during, commits/s before].\n"
@@ -801,8 +842,8 @@ def _write_definition() -> None:
         "- secondary.peer7_2048: config 5's peer shape; wire decomp as "
         "above.\n"
         "- secondary.mesh_10240: sharded resident engine over 8 virtual "
-        "CPU devices, run back-to-back with the sim 10240 trials: cps/"
-        "spread vs sim_cps/sim_spread.\n"
+        "CPU devices, run back-to-back with the sim 10240 trials: "
+        "[cps, spread, sim cps, sim spread].\n"
         "- secondary.sparse: [hibernate cps, hibernate p99 ms, groups "
         "asleep, plain cps, plain p99 ms] at 10240 hosted / 1024 "
         "active.\n"
@@ -820,8 +861,9 @@ def _write_definition() -> None:
         "is the wall): [pg c/s, shared c/s, speedup]; modeled, not a "
         "disk measurement.\n"
         "- secondary.grpc_1024: both engine modes over gRPC at the "
-        "headline shape; scalar completes only on top of round-5 storm "
-        "containment (scalar_dnf records this run).\n"
+        "headline shape — [batched cps, batched p99 ms, scalar cps "
+        "(null = dnf; scalar completes only on top of round-5 storm "
+        "containment), scalar cps at 256 groups].\n"
         "- secondary.tpu_e2e: the 1024-group rung with the engine on the "
         "real chip via the axon tunnel (cps, p50) or dnf + the tunnel "
         "error.\n"
@@ -905,7 +947,7 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                mixed, stream, grpc_b, grpc_s_1024, grpc_s_256, kernel,
                kernel_100k, tpu_e2e, traced, filestore5, readmix,
                snapcatch, win_sweep=None, chaos=None, tel_on=None,
-               tel_off=None, mixed_fs=None) -> dict:
+               tel_off=None, mixed_fs=None, zipf=None) -> dict:
     """Build the one-line JSON summary.  COMPACT by contract: the whole
     line must parse from the driver's 2000-char tail window (r5 lost its
     flagship number to overflow), so keys are short, numbers rounded, and
@@ -1020,11 +1062,12 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                 "wire": _compact_decomp(
                     peer7.get("host_path_decomposition")),
             },
+            # [cps, spread, sim cps, sim spread] (compact list form)
             "mesh_10240": (
-                {"dnf": True} if not mesh_cps else {
-                    "cps": _median(mesh_cps), "spread": _spread(mesh_cps),
-                    "sim_cps": _median(sim10k_cps) if sim10k_cps else None,
-                    "sim_spread": _spread(sim10k_cps)}),
+                {"dnf": True} if not mesh_cps else
+                [_median(mesh_cps), _spread(mesh_cps),
+                 _median(sim10k_cps) if sim10k_cps else None,
+                 _spread(sim10k_cps)]),
             "sim_ladder": {str(g): r0(_median(
                 [t["commits_per_sec"] for t in r]))
                 for g, r in sorted(ladder.items())},
@@ -1078,6 +1121,14 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                          readmix["reads_lease_leader"],
                          readmix["reads_follower_linearizable"],
                          readmix["reads_stale"]]),
+            # round-13 serving plane, zipf client fleet: [writes/s,
+            # linearizable reads/s, shed fraction (typed overload
+            # replies / intake), p99 ms under overload]; the overload/
+            # unsaturated p99 ratio and the hot-group sketch share stay
+            # in the rung's own RESULT record
+            "zipf": ({"dnf": True} if zipf is None or zipf.get("dnf") else
+                     [zipf["writes_per_sec"], zipf["reads_per_sec"],
+                      zipf["shed_frac"], zipf.get("p99_ms")]),
             # wipe-one-server catch-up: [catchup s, chunked installs,
             # commits/s during installs, commits/s before]
             "snap_1024": ({"dnf": True} if snapcatch.get("dnf") else
@@ -1093,14 +1144,14 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                 [chaos["passed"], chaos["total"],
                  chaos["worst_reelect_s"], chaos["recovery_frac"],
                  chaos["fault_events"]]),
-            "grpc_1024": {
-                "cps": _median(
-                    [t["commits_per_sec"] for t in grpc_b]),
-                "p99": _median([t["p99_ms"] for t in grpc_b]),
-                "scalar_dnf": bool(grpc_s_1024.get("dnf")),
-                "scalar": grpc_s_1024.get("commits_per_sec"),
-                "s256": grpc_s_256.get("commits_per_sec"),
-            },
+            # [cps, p99 ms, scalar cps (null = dnf), scalar cps at 256
+            # groups] (compact list form)
+            "grpc_1024": [
+                _median([t["commits_per_sec"] for t in grpc_b]),
+                _median([t["p99_ms"] for t in grpc_b]),
+                grpc_s_1024.get("commits_per_sec"),
+                grpc_s_256.get("commits_per_sec"),
+            ],
             "tpu_e2e": (
                 {"dnf": True, "err": str(tpu_e2e.get(
                     "reason", tpu_e2e.get("timeout_s", "")))[:40]}
@@ -1142,6 +1193,8 @@ if __name__ == "__main__":
         child_readmix()
     elif len(sys.argv) > 1 and sys.argv[1] == "--snapcatch-child":
         child_snapcatch()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-child":
+        child_zipf()
     elif len(sys.argv) > 1 and sys.argv[1] == "--chaos-child":
         child_chaos()
     else:

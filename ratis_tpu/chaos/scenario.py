@@ -35,6 +35,8 @@ from typing import Optional
 
 from ratis_tpu.chaos.faults import Step
 from ratis_tpu.chaos.link import link_faults
+from ratis_tpu.protocol.exceptions import (RaftRetryFailureException,
+                                           ResourceUnavailableException)
 from ratis_tpu.server.watchdog import (KIND_FAULT_RECOVERED,
                                        KIND_INJECTED_FAULT)
 from ratis_tpu.util import injection
@@ -133,6 +135,12 @@ class _Writers:
         self.acked_per_group: dict = {}
         self.attempts_per_group: dict = {}
         self.attempts = 0
+        # overload accounting (recording mode): a shed write surfaces as
+        # a typed ResourceUnavailableException (possibly wrapped in a
+        # retry-failure after the policy gives up) — a TIMEOUT means a
+        # request was silently dropped, which the overload SLO forbids
+        self.timeouts = 0
+        self.shed_surfaced = 0
         # counter-oracle baseline: per-(gid, replica) counter value at run
         # start, so back-to-back scenarios on one cluster verify DELTAS
         self.counter_base: dict = {}
@@ -161,6 +169,14 @@ class _Writers:
                     if reply.success:
                         self.acked.append(payload)
                         self.ack_times.append(time.monotonic())
+                    elif isinstance(reply.exception,
+                                    ResourceUnavailableException):
+                        self.shed_surfaced += 1
+                except asyncio.TimeoutError:
+                    self.timeouts += 1
+                except RaftRetryFailureException as e:
+                    if isinstance(e.cause, ResourceUnavailableException):
+                        self.shed_surfaced += 1
                 except Exception:
                     pass  # unacked: may or may not have committed
                 await asyncio.sleep(0.002)
@@ -399,6 +415,9 @@ class ScenarioRunner:
         except TimeoutError:
             pass  # verified again (and enforced) after the heal
         writers.snapshot_counters()
+        # shed baseline: back-to-back scenarios on one long-lived
+        # cluster must assert THIS run's shedding, not the campaign's
+        self._shed_base = self._shed_now()
         self._t0 = time.monotonic()
         writers.start()
         try:
@@ -465,6 +484,7 @@ class ScenarioRunner:
                               f"(reelect {res.slos['reelect_s']}s)",
                               fault_id=rec["fault"])
             # ------------------------------------------------ invariants
+            await self._settle_replicas()
             self._verify(writers)
             res.passed = True
         except Exception as e:  # CancelledError (BaseException) propagates
@@ -481,6 +501,26 @@ class ScenarioRunner:
                     LOG.exception("post-scenario restart of %s failed",
                                   victim)
         return res
+
+    async def _settle_replicas(self, timeout: float = 10.0) -> None:
+        """Writers are stopped and faults healed, but wait_quiesced samples
+        the leader's commit once — a commit landing after its settled pass
+        leaves a follower's apply a few entries behind at snapshot time.
+        That gap is in-flight apply work, not divergence: wait it out
+        bounded (a true divergence never closes, so _verify still fires)."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            if all(len({d.applied_index
+                        for d in self.cluster.divisions(g.group_id)}) <= 1
+                   for g in self.cluster.groups):
+                return
+            await asyncio.sleep(0.05)
+
+    def _shed_now(self) -> int:
+        return sum(s.serving.admission.shed_total
+                   for s in self.cluster.servers.values()
+                   if getattr(s, "serving", None) is not None)
 
     def _verify(self, writers: _Writers) -> None:
         sc, res = self.scenario, self.result
@@ -544,6 +584,22 @@ class ScenarioRunner:
         assert res.acked >= min_acked, \
             (f"[seed {seed}] scenario acked only {res.acked} writes "
              f"(< {min_acked}): load never got through")
+        # Overload SLO (serving plane): shedding must have actually
+        # happened (the budget was crossed), every shed attempt must
+        # have surfaced as a TYPED overload reply — a client timeout is
+        # a silent drop, exactly what bounded pending exists to prevent.
+        shed_total = self._shed_now() - getattr(self, "_shed_base", 0)
+        res.checks["shed_total"] = shed_total
+        res.checks["client_timeouts"] = writers.timeouts
+        res.checks["shed_surfaced"] = writers.shed_surfaced
+        if sc.config.get("expect_shed"):
+            assert shed_total > 0, \
+                (f"[seed {seed}] overload scenario never crossed the "
+                 f"pending budget: nothing was shed")
+            assert writers.timeouts == 0, \
+                (f"[seed {seed}] {writers.timeouts} client timeout(s) "
+                 f"under overload: shed requests must get typed replies, "
+                 f"not silent drops")
 
 
 async def run_scenario(cluster, scenario: Scenario,

@@ -249,6 +249,32 @@ def _shared_log_tail_loss(rng: random.Random, cfg: dict) -> tuple:
             make_step(t + down, "restart", truncate_tail=tail))
 
 
+@_scenario("overload_shed")
+def _overload_shed(rng: random.Random, cfg: dict) -> tuple:
+    """Serving-plane overload (round 12): degrade the follower links so
+    commits slow to a crawl while writers keep pushing — the leader's
+    intake backs past its per-shard pending budget
+    (raft.tpu.serving.admission.*) and admission control must shed the
+    overflow with TYPED overload replies.  SLO = the usual zero lost
+    acks + exactly-once, plus (with ``expect_shed`` in the config) that
+    shedding actually happened and every unacked attempt surfaced as a
+    typed reply, not a silent client timeout — bounded pending, not p99
+    collapse."""
+    hold = _hold(cfg, round(rng.uniform(1.5, 2.5), 2))
+    t = _WARM_S + rng.uniform(0, 0.3)
+    # BOTH followers degraded: with one slow follower the other still
+    # completes the majority at full speed and nothing ever queues
+    return (make_step(t, "link", "follower:0",
+                      latency_ms=round(rng.uniform(40, 80), 1),
+                      jitter_ms=round(rng.uniform(5, 15), 1),
+                      drop_rate=0.0),
+            make_step(t + 0.1, "link", "follower:1",
+                      latency_ms=round(rng.uniform(40, 80), 1),
+                      jitter_ms=round(rng.uniform(5, 15), 1),
+                      drop_rate=0.0),
+            make_step(t + hold, "heal"))
+
+
 @_scenario("window_crash")
 def _window_crash(rng: random.Random, cfg: dict) -> tuple:
     """Round-9 window-protocol recovery: slow a follower so depth>1

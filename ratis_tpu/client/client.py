@@ -221,9 +221,11 @@ class RaftClient:
     async def _retry_loop(self, req: RaftClientRequest, sticky: bool,
                           ordering: Optional[tuple] = None
                           ) -> RaftClientReply:
+        from ratis_tpu.protocol.exceptions import ResourceUnavailableException
         window, seq = ordering if ordering is not None else (None, -1)
         attempt = 0
         while True:
+            retry_after_s = 0.0
             attempt += 1
             target = req.server_id if sticky else \
                 (self._leader_id or self._next_peer(None))
@@ -272,6 +274,11 @@ class RaftClient:
                         window.reset_first_seq()
                 elif isinstance(exc, _RETRY_SAME):
                     cause = exc
+                elif isinstance(exc, ResourceUnavailableException):
+                    # shed by admission control: retry the same server, but
+                    # back off at least the server's retry-after hint
+                    cause = exc
+                    retry_after_s = exc.retry_after_ms / 1000.0
                 else:
                     return reply  # a real failure: surface to the caller
 
@@ -281,7 +288,7 @@ class RaftClient:
                 raise RaftRetryFailureException(
                     f"{req} failed after {attempt} attempts "
                     f"(policy {self.retry_policy}): {cause}")
-            sleep = action.sleep_time.seconds
+            sleep = max(action.sleep_time.seconds, retry_after_s)
             if sleep > 0:
                 await asyncio.sleep(sleep)
 
@@ -353,9 +360,22 @@ class OrderedApi:
     seqNum order even when the transport delivers them out of order, so two
     concurrent ``send()``s always commit in submission order."""
 
-    def __init__(self, client: RaftClient, max_outstanding: int = 128):
+    def __init__(self, client: RaftClient,
+                 max_outstanding: Optional[int] = None):
         from ratis_tpu.util.sliding_window import SlidingWindowClient
+        if max_outstanding is None:
+            # raft.client.async.outstanding-requests.max: one connection
+            # carries this many pipelined ordered requests — set it in the
+            # thousands for fleet-scale pipelining
+            from ratis_tpu.conf.keys import RaftClientConfigKeys
+            if client.properties is not None:
+                max_outstanding = \
+                    RaftClientConfigKeys.Async.outstanding_requests_max(
+                        client.properties)
+            else:
+                max_outstanding = 128
         self.client = client
+        self.max_outstanding = max_outstanding
         self._sem = asyncio.Semaphore(max_outstanding)
         self._window = SlidingWindowClient(name=str(client.client_id))
 

@@ -555,6 +555,86 @@ class RaftServerConfigKeys:
             return p.get(RaftServerConfigKeys.Telemetry.FLIGHT_DIR_KEY,
                          RaftServerConfigKeys.Telemetry.FLIGHT_DIR_DEFAULT)
 
+    class Serving:
+        """Production serving plane (ratis_tpu.server.serving; reference
+        analogs: RaftServerImpl's pending-request element/byte limits and
+        resource checks, ReadRequests' readIndex machinery).  Two halves:
+        admission control bounds the pending intake per loop shard (count
+        and bytes) and sheds overflow with a typed
+        ResourceUnavailableException carrying a retry-after hint, so a
+        saturated shard degrades into fast typed rejections instead of a
+        p99 collapse; the batched-read scheduler coalesces the readIndex
+        leadership-confirmation round across every group with pending
+        linearizable reads on a shard into one zero-entry append envelope
+        per destination peer, amortizing the per-group heartbeat round the
+        same way the quorum engine amortizes per-group math.  Admission is
+        off by default (every request admitted); read batching is on by
+        default and falls back to the scalar per-group confirmation when
+        disabled."""
+
+        ADMISSION_ENABLED_KEY = "raft.tpu.serving.admission.enabled"
+        ADMISSION_ENABLED_DEFAULT = False
+        # per-loop-shard bounds on requests admitted but not yet replied
+        PENDING_ELEMENT_LIMIT_KEY = "raft.tpu.serving.admission.pending.element-limit"
+        PENDING_ELEMENT_LIMIT_DEFAULT = 8192
+        PENDING_BYTE_LIMIT_KEY = "raft.tpu.serving.admission.pending.byte-limit"
+        PENDING_BYTE_LIMIT_DEFAULT = "64MB"
+        # base retry-after hint carried in shed replies; scaled by overshoot
+        RETRY_AFTER_KEY = "raft.tpu.serving.admission.retry-after"
+        RETRY_AFTER_DEFAULT = TimeDuration.valueOf("200ms")
+        READ_BATCH_ENABLED_KEY = "raft.tpu.serving.read-batch.enabled"
+        READ_BATCH_ENABLED_DEFAULT = True
+        # extra coalescing delay before a confirmation sweep fires; 0 =
+        # coalesce only what arrives in the same event-loop pass
+        READ_BATCH_WINDOW_KEY = "raft.tpu.serving.read-batch.window"
+        READ_BATCH_WINDOW_DEFAULT = TimeDuration.valueOf("0ms")
+        # sustained shed rate (sheds/s over a watchdog interval) above
+        # which an overload event is journaled and health degrades
+        OVERLOAD_SHED_RATE_KEY = "raft.tpu.serving.overload.shed-rate"
+        OVERLOAD_SHED_RATE_DEFAULT = 50.0
+
+        @staticmethod
+        def admission_enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Serving.ADMISSION_ENABLED_KEY,
+                RaftServerConfigKeys.Serving.ADMISSION_ENABLED_DEFAULT)
+
+        @staticmethod
+        def pending_element_limit(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Serving.PENDING_ELEMENT_LIMIT_KEY,
+                RaftServerConfigKeys.Serving.PENDING_ELEMENT_LIMIT_DEFAULT)
+
+        @staticmethod
+        def pending_byte_limit(p: RaftProperties) -> int:
+            return p.get_size(
+                RaftServerConfigKeys.Serving.PENDING_BYTE_LIMIT_KEY,
+                RaftServerConfigKeys.Serving.PENDING_BYTE_LIMIT_DEFAULT)
+
+        @staticmethod
+        def retry_after(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Serving.RETRY_AFTER_KEY,
+                RaftServerConfigKeys.Serving.RETRY_AFTER_DEFAULT)
+
+        @staticmethod
+        def read_batch_enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Serving.READ_BATCH_ENABLED_KEY,
+                RaftServerConfigKeys.Serving.READ_BATCH_ENABLED_DEFAULT)
+
+        @staticmethod
+        def read_batch_window(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Serving.READ_BATCH_WINDOW_KEY,
+                RaftServerConfigKeys.Serving.READ_BATCH_WINDOW_DEFAULT)
+
+        @staticmethod
+        def overload_shed_rate(p: RaftProperties) -> float:
+            return p.get_float(
+                RaftServerConfigKeys.Serving.OVERLOAD_SHED_RATE_KEY,
+                RaftServerConfigKeys.Serving.OVERLOAD_SHED_RATE_DEFAULT)
+
     class Chaos:
         """Chaos campaign subsystem (ratis_tpu.chaos; reference analogs:
         RaftExceptionBaseTest, the kill/restart suites over simulated RPC,

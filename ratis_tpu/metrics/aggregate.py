@@ -165,6 +165,7 @@ def _summarize_proc(scrape: dict) -> dict:
         "watchdogEvents": events.get("count", 0),
         "eventKinds": sorted({e.get("kind")
                               for e in events.get("events", [])}),
+        "shedRequests": (health.get("serving") or {}).get("shedTotal", 0),
     }
 
 

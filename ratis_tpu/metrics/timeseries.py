@@ -252,6 +252,8 @@ class TelemetrySampler:
             "events": (srv.watchdog.event_count()
                        if srv.watchdog is not None else 0),
             "fsyncs": self._fsync_reads(),
+            "shed": (srv.serving.admission.shed_total
+                     if getattr(srv, "serving", None) is not None else 0),
         }
 
     def _fsync_reads(self) -> int:
@@ -274,7 +276,7 @@ class TelemetrySampler:
         rates = {f"{k}_per_s": round(
             max(0, counts[k] - self._last_counts.get(k, 0)) / dt, 3)
             for k in ("commits", "acks", "rewinds", "dispatches",
-                      "fsyncs")}
+                      "fsyncs", "shed")}
         # dispatch latency over THIS interval: timer (count, sum) delta
         # feeds the windowed log2 buckets the quantiles read from
         timer = self.server.engine._m.dispatch_timer

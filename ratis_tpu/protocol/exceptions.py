@@ -127,7 +127,17 @@ class TimeoutIOException(RaftException):
 
 class ResourceUnavailableException(RaftException):
     """Server resource limits hit (pending-request permits, retry-cache size);
-    client backs off (reference ResourceUnavailableException.java)."""
+    client backs off (reference ResourceUnavailableException.java).
+
+    Carries an optional retry-after hint (milliseconds) set by the serving
+    plane's admission controller so shed clients back off for at least the
+    server-suggested interval instead of hammering a saturated shard."""
+
+    retry_after_ms = 0
+
+    def __init__(self, msg: str = "", retry_after_ms: int = 0):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
 
 
 class ReadException(RaftException):
@@ -213,6 +223,8 @@ def exception_to_wire(e: BaseException) -> dict:
         d.update(call_id=e.call_id,
                  replication=None if e.replication is None else int(e.replication),
                  log_index=e.log_index)
+    if isinstance(e, ResourceUnavailableException) and e.retry_after_ms:
+        d["retry_after_ms"] = e.retry_after_ms
     return d
 
 
@@ -235,6 +247,9 @@ def exception_from_wire(d: dict) -> RaftException:
             replication=None if repl is None else ReplicationLevel(repl),
             log_index=d.get("log_index", -1))
         e.args = (msg,)
+        return e
+    if cls is ResourceUnavailableException:
+        e = ResourceUnavailableException(msg, retry_after_ms=d.get("retry_after_ms", 0))
         return e
     # Generic path: never route msg through a typed first parameter (e.g.
     # LeaderNotReadyException(member_id), RaftRetryFailureException(request)).

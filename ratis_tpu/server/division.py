@@ -1976,6 +1976,14 @@ class Division:
         read_index = self.state.log.get_last_committed_index()
         if self.lease.enabled and self._lease_valid():
             return read_index
+        # Batched confirmation (serving plane): every group with pending
+        # reads on this shard shares one zero-entry envelope sweep per
+        # destination instead of a per-group heartbeat round.
+        serving = getattr(self.server, "serving", None)
+        scheduler = getattr(serving, "read_batch", None)
+        if scheduler is not None:
+            await asyncio.shield(scheduler.confirm(self))
+            return read_index
         # Share one in-flight confirmation round among concurrent reads
         # (reference ReadIndexHeartbeats.AppendEntriesListeners:126).
         if self._confirm_inflight is None or self._confirm_inflight.done():
@@ -2244,6 +2252,9 @@ class Division:
             if now - self._last_cache_sweep > self.retry_cache.expiry_s / 4:
                 self._last_cache_sweep = now
                 self.retry_cache.sweep()
+                # same cadence for the write-index cache: the lazy get()
+                # path never evicts ids that stop querying
+                self.write_index_cache.sweep(now)
 
     def _flush_reply_batch(self, batch: list) -> None:
         """One waterline fan-out pass: resolve every client waiter the

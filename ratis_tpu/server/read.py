@@ -67,6 +67,22 @@ class WriteIndexCache:
             return -1
         return index
 
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Drop every expired entry (the lazy ``get`` path only evicts
+        keys that are queried again — a fleet of transient client ids
+        would otherwise accrete one entry each, forever).  Called from
+        the apply loop's slow tick; returns the number evicted."""
+        if now is None:
+            now = time.monotonic()
+        dead = [cid for cid, (_, t) in self._map.items()
+                if (now - t) > self.expiry_s]
+        for cid in dead:
+            del self._map[cid]
+        return len(dead)
+
 
 class LeaseState:
     """Host mirror of the lease decision; the expiry itself comes from the

@@ -450,6 +450,10 @@ class RaftServer:
                         lambda d=dest: self.replication.window_occupancy(d))
 
         self.replication.on_destination = _register_window_gauges
+        # Serving plane (ratis_tpu.server.serving): intake admission
+        # control + the batched readIndex scheduler, raft.tpu.serving.*.
+        from ratis_tpu.server.serving import ServingPlane
+        self.serving = ServingPlane(self)
         # single source of truth for the heartbeat cadence (LeaderContext
         # and the sweep must agree, or heartbeat gaps silently grow)
         self.heartbeat_interval_s = \
@@ -658,6 +662,7 @@ class RaftServer:
         self._lanes.clear()
         from ratis_tpu.metrics.registry import MetricRegistries
         MetricRegistries.global_registries().remove(self._plane_info)
+        self.serving.close()
         await self.engine.close()
         if self.shards is not None:
             await self.shards.close()
@@ -898,6 +903,12 @@ class RaftServer:
             },
             "watchdogEvents": (self.watchdog.event_count()
                                if self.watchdog is not None else 0),
+            "serving": {
+                "admissionEnabled": self.serving.admission.enabled,
+                "shedTotal": self.serving.admission.shed_total,
+                "pendingCount": sum(self.serving.admission.pending_count),
+                "pendingBytes": sum(self.serving.admission.pending_bytes),
+            },
             "chaos": self.chaos_info(),
         }
 
@@ -1274,29 +1285,56 @@ class RaftServer:
             div = self.get_division(request.group_id)
         except GroupMismatchException as e:
             return RaftClientReply.failure_reply(request, e)
+        # Admission control (serving plane): a shard over its pending
+        # budget sheds here with a typed overload reply — the request
+        # never hops to the saturated division loop.
+        shed, ticket = self.serving.admission.try_admit(request)
+        if shed is not None:
+            return shed
+        wrapped_sink = False
+        if ticket is not None:
+            from ratis_tpu.protocol.requests import (attach_reply_sink,
+                                                     reply_sink_of)
+            sink = reply_sink_of(request)
+            if sink is not None:
+                # deferred replies bypass the handler return: the budget
+                # is held until the waterline fan-out delivers through
+                # the transport sink
+                def _release_sink(reply, _sink=sink, _t=ticket):
+                    _t.release()
+                    _sink(reply)
+                attach_reply_sink(request, _release_sink)
+                wrapped_sink = True
         if trace_t0:
             TRACER.record(request.trace_id, STAGE_ROUTE, trace_t0,
                           TRACER.now())
+        deferred = False
         try:
-            # sharded: the division's whole submit path (windows, append,
-            # quorum wait, apply wait) runs on its pinned loop
-            reply = await self._run_on_division_loop(
-                request.group_id, div.submit_client_request(request))
-        except RaftException as e:
-            return RaftClientReply.failure_reply(request, e)
-        except Exception as e:  # never leak raw errors to the wire
-            LOG.exception("%s request failed", self.peer_id)
-            return RaftClientReply.failure_reply(request, RaftException(str(e)))
-        if reply is DEFERRED_REPLY:
-            # deferred-reply fast path: the waterline fan-out delivers the
-            # real reply through the request's transport sink at commit
-            # (the respond span is recorded there, not via mark_egress)
+            try:
+                # sharded: the division's whole submit path (windows, append,
+                # quorum wait, apply wait) runs on its pinned loop
+                reply = await self._run_on_division_loop(
+                    request.group_id, div.submit_client_request(request))
+            except RaftException as e:
+                return RaftClientReply.failure_reply(request, e)
+            except Exception as e:  # never leak raw errors to the wire
+                LOG.exception("%s request failed", self.peer_id)
+                return RaftClientReply.failure_reply(
+                    request, RaftException(str(e)))
+            if reply is DEFERRED_REPLY:
+                # deferred-reply fast path: the waterline fan-out delivers the
+                # real reply through the request's transport sink at commit
+                # (the respond span is recorded there, not via mark_egress)
+                deferred = True
+                return reply
+            if trace_t0:
+                # the transport pops this to close the respond span (handler
+                # done -> reply serialized/handed back)
+                TRACER.mark_egress(request.trace_id)
             return reply
-        if trace_t0:
-            # the transport pops this to close the respond span (handler
-            # done -> reply serialized/handed back)
-            TRACER.mark_egress(request.trace_id)
-        return reply
+        finally:
+            if ticket is not None and not (deferred and wrapped_sink):
+                ticket.release()
 
     async def submit_data_stream_request(self, request: RaftClientRequest
                                          ) -> RaftClientReply:

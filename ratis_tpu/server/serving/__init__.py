@@ -1,0 +1,70 @@
+"""Production serving plane: admission control + batched linearizable reads.
+
+Two halves, one per module, configured by ``raft.tpu.serving.*``
+(RaftServerConfigKeys.Serving):
+
+- admission (serving.admission): per-loop-shard bounded pending budgets
+  (count + bytes) enforced at client intake, before the request hops to a
+  division loop.  Overflow is shed with a typed
+  ResourceUnavailableException carrying a retry-after hint, so a
+  saturated shard degrades into fast typed rejections instead of a p99
+  collapse.  The check lives in RaftServer._handle_client_request — the
+  single intake every transport (TCP, gRPC, simulated) funnels through —
+  so the typed reply crosses all three wires identically.
+
+- batched reads (serving.readbatch): one cross-group readIndex
+  leadership-confirmation sweep per shard, riding the replication lane
+  protocol as zero-entry unsequenced append envelopes, amortizing the
+  per-group heartbeat round the same way the quorum engine amortizes
+  per-group math.  The leader-lease fast path in Division's
+  _leader_read_index still skips the round entirely while the lease
+  holds; the scheduler only sees reads that actually need confirmation.
+
+The plane registers a ``serving_plane`` metric registry (sheddedRequests,
+per-shard pending gauges, confirmation sweep counters) mirroring the
+replication plane's registry, and feeds the watchdog's sustained-overload
+detection and the telemetry sampler's shed counter.
+"""
+
+from __future__ import annotations
+
+from ratis_tpu.conf.keys import RaftServerConfigKeys
+from ratis_tpu.server.serving.admission import AdmissionController
+from ratis_tpu.server.serving.readbatch import ReadIndexScheduler
+
+__all__ = ["ServingPlane", "AdmissionController", "ReadIndexScheduler"]
+
+
+class ServingPlane:
+    """Per-server serving-plane root: owns the admission controller and
+    the batched-read scheduler, and their shared metric registry."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+        p = server.properties
+        self.admission = AdmissionController(server)
+        self.read_batch = (ReadIndexScheduler(server)
+                           if RaftServerConfigKeys.Serving.read_batch_enabled(p)
+                           else None)
+        from ratis_tpu.metrics.registry import (MetricRegistries,
+                                                MetricRegistryInfo, labeled)
+        self._registry_info = MetricRegistryInfo(
+            prefix=str(server.peer_id), application="ratis",
+            component="server", name="serving_plane")
+        plane = MetricRegistries.global_registries().create(self._registry_info)
+        adm = self.admission
+        plane.gauge("sheddedRequests", lambda: adm.shed_total)
+        plane.gauge("admittedRequests", lambda: adm.admitted_total)
+        for i in range(adm.n_shards):
+            plane.gauge(labeled("servingPendingCount", shard=i),
+                        lambda s=i: adm.pending_count[s])
+            plane.gauge(labeled("servingPendingBytes", shard=i),
+                        lambda s=i: adm.pending_bytes[s])
+        if self.read_batch is not None:
+            rb = self.read_batch
+            plane.gauge("readConfirmSweeps", lambda: rb.sweeps)
+            plane.gauge("readConfirmBatchedReads", lambda: rb.confirmed)
+
+    def close(self) -> None:
+        from ratis_tpu.metrics.registry import MetricRegistries
+        MetricRegistries.global_registries().remove(self._registry_info)

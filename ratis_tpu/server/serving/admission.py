@@ -1,0 +1,108 @@
+"""Intake admission control: per-loop-shard bounded pending budgets.
+
+Reference analog: RaftServerImpl's resource checks over PendingRequests'
+element/byte limits (PendingRequests.java RequestLimits) — a request past
+the limit is rejected with ResourceUnavailableException instead of being
+queued.  Here the budget is per loop shard (the unit that saturates: one
+shard's event loop backs up while its neighbors idle), counted at the
+single client intake all transports share, and the typed reply carries a
+retry-after hint the client's retry loop honors.
+
+A shed request never reaches the division loop — the reply is synthesized
+at intake, so a saturated shard's rejection path costs one dict hop and
+no cross-loop scheduling.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ratis_tpu.conf.keys import RaftServerConfigKeys
+from ratis_tpu.protocol.exceptions import ResourceUnavailableException
+from ratis_tpu.protocol.requests import (RaftClientReply, RaftClientRequest,
+                                         RequestType)
+
+LOG = logging.getLogger(__name__)
+
+# Request types that consume pending budget: the data plane.  Admin
+# traffic (group management, snapshot ops, conf changes) is rare, small,
+# and must stay serviceable while the data plane sheds.
+_BUDGETED = frozenset({
+    RequestType.WRITE, RequestType.READ, RequestType.STALE_READ,
+    RequestType.WATCH, RequestType.MESSAGE_STREAM, RequestType.DATA_STREAM,
+    RequestType.FORWARD,
+})
+
+
+class _Ticket:
+    """One admitted request's budget hold; release is idempotent (the
+    intake's finally and the deferred-reply sink wrapper can both fire)."""
+
+    __slots__ = ("ctrl", "shard", "nbytes", "released")
+
+    def __init__(self, ctrl: "AdmissionController", shard: int, nbytes: int):
+        self.ctrl = ctrl
+        self.shard = shard
+        self.nbytes = nbytes
+        self.released = False
+
+    def release(self) -> None:
+        if self.released:
+            return
+        self.released = True
+        self.ctrl.pending_count[self.shard] -= 1
+        self.ctrl.pending_bytes[self.shard] -= self.nbytes
+
+
+class AdmissionController:
+    """Per-shard pending count/byte budgets with typed overload replies.
+
+    With admission disabled (the default) ``try_admit`` returns
+    ``(None, None)`` without touching any counter — the request path is
+    exactly the pre-serving-plane path."""
+
+    def __init__(self, server) -> None:
+        p = server.properties
+        keys = RaftServerConfigKeys.Serving
+        self.server = server
+        self.enabled = keys.admission_enabled(p)
+        self.element_limit = keys.pending_element_limit(p)
+        self.byte_limit = keys.pending_byte_limit(p)
+        self.retry_after_ms = max(1, int(keys.retry_after(p).seconds * 1000))
+        self.n_shards = max(1, server.loop_shards or 1)
+        self.pending_count = [0] * self.n_shards
+        self.pending_bytes = [0] * self.n_shards
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.shed_by_shard = [0] * self.n_shards
+
+    def try_admit(self, request: RaftClientRequest
+                  ) -> tuple[Optional[RaftClientReply], Optional[_Ticket]]:
+        """(shed_reply, None) when over budget; (None, ticket) when the
+        request was admitted and holds budget until ``ticket.release()``;
+        (None, None) when admission does not apply (disabled or an
+        exempt admin request type)."""
+        if not self.enabled or request.type.type not in _BUDGETED:
+            return None, None
+        shard = self.server.shard_of_group(request.group_id)
+        nbytes = len(request.message.content) if request.message else 0
+        count = self.pending_count[shard]
+        size = self.pending_bytes[shard]
+        if count >= self.element_limit or size + nbytes > self.byte_limit:
+            self.shed_total += 1
+            self.shed_by_shard[shard] += 1
+            # scale the hint with overshoot so a deeply saturated shard
+            # pushes clients further out than one grazing the limit
+            over = max(count / max(1, self.element_limit),
+                       (size + nbytes) / max(1, self.byte_limit))
+            hint_ms = int(self.retry_after_ms * min(8.0, max(1.0, over)))
+            return RaftClientReply.failure_reply(request, ResourceUnavailableException(
+                f"{self.server.peer_id} shard {shard} over pending budget "
+                f"({count}/{self.element_limit} requests, "
+                f"{size}/{self.byte_limit} bytes)",
+                retry_after_ms=hint_ms)), None
+        self.pending_count[shard] = count + 1
+        self.pending_bytes[shard] = size + nbytes
+        self.admitted_total += 1
+        return None, _Ticket(self, shard, nbytes)

@@ -1,0 +1,182 @@
+"""Batched readIndex confirmation: one sweep per shard, all groups at once.
+
+The scalar path (Division._confirm_leadership) proves leadership with one
+empty-append round per group per read burst — at 1024 groups with
+concurrent readers that is 1024 heartbeat round trips per sweep interval,
+exactly the O(groups) RPC wall the replication envelope removed for
+appends.  This scheduler coalesces every group with a pending
+linearizable read on a loop shard into ONE zero-entry unsequenced
+AppendEnvelope per destination peer (seq=-1: processed immediately,
+bit-identical to the legacy frame), and counts each group's majority from
+the envelope reply's aligned per-item AppendEntriesReplies.
+
+The confirmation semantics per group are exactly the scalar path's: an
+empty AppendEntriesRequest at the group's current term, acked by SUCCESS
+or INCONSISTENCY (either proves the follower recognizes this term's
+leader — ReadIndexHeartbeats' AppendEntriesListeners:126), majority
+counted excluding self.  Only the transport framing is batched.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ratis_tpu.conf.keys import RaftServerConfigKeys
+from ratis_tpu.protocol.exceptions import ReadIndexException
+from ratis_tpu.protocol.raftrpc import (AppendEntriesRequest, AppendEnvelope,
+                                        AppendResult, RaftRpcHeader)
+
+LOG = logging.getLogger(__name__)
+
+
+class _Entry:
+    """One group's pending confirmation in the next sweep."""
+
+    __slots__ = ("division", "future", "waiters")
+
+    def __init__(self, division, future: asyncio.Future):
+        self.division = division
+        self.future = future
+        self.waiters = 1
+
+
+class _ShardState:
+    __slots__ = ("pending", "armed")
+
+    def __init__(self):
+        self.pending: dict = {}  # group_id -> _Entry
+        self.armed = False
+
+
+class ReadIndexScheduler:
+    """Per-shard cross-group readIndex confirmation sweeps.
+
+    ``confirm(division)`` is called on the division's loop; all of a
+    shard's state is touched only from that shard's loop, so no locks.
+    Reads arriving in the same event-loop pass (plus an optional
+    ``read-batch.window`` delay) share one sweep; concurrent reads of one
+    group share one future within a sweep."""
+
+    def __init__(self, server) -> None:
+        p = server.properties
+        self.server = server
+        self.window_s = \
+            RaftServerConfigKeys.Serving.read_batch_window(p).seconds
+        self.timeout_s = RaftServerConfigKeys.Read.timeout(p).seconds
+        self._shards: dict[int, _ShardState] = {}
+        self.sweeps = 0       # batched confirmation rounds fired
+        self.confirmed = 0    # reads whose confirmation rode a sweep
+
+    def confirm(self, division) -> asyncio.Future:
+        """Future resolving when ``division``'s leadership is confirmed by
+        a batched sweep (ReadIndexException on failure).  Callers should
+        ``asyncio.shield`` the await: the future is shared by every
+        concurrent reader of the group in this sweep."""
+        loop = asyncio.get_running_loop()
+        others = [p for p in division.state.configuration.voting_peers()
+                  if p.id != division.member_id.peer_id]
+        if not others:
+            # single-voter group: leadership is self-evident, no round
+            fut = loop.create_future()
+            fut.set_result(None)
+            return fut
+        shard = self.server.shard_of_group(division.group_id)
+        state = self._shards.setdefault(shard, _ShardState())
+        entry = state.pending.get(division.group_id)
+        if entry is not None:
+            entry.waiters += 1
+            return entry.future
+        entry = _Entry(division, loop.create_future())
+        state.pending[division.group_id] = entry
+        if not state.armed:
+            state.armed = True
+            if self.window_s > 0:
+                loop.call_later(self.window_s, self._fire, shard)
+            else:
+                loop.call_soon(self._fire, shard)
+        return entry.future
+
+    def _fire(self, shard: int) -> None:
+        state = self._shards.get(shard)
+        if state is None or not state.pending:
+            if state is not None:
+                state.armed = False
+            return
+        batch = state.pending
+        state.pending = {}
+        state.armed = False
+        self.sweeps += 1
+        asyncio.ensure_future(self._sweep(batch))
+
+    async def _sweep(self, batch: dict) -> None:
+        """One confirmation round over every group in ``batch``: one
+        zero-entry envelope per destination peer, per-group majority
+        counted from the aligned reply items."""
+        need: dict = {}      # group_id -> acks still needed
+        acks: dict = {}      # group_id -> acks seen
+        # destination peer id -> list of (group_id, AppendEntriesRequest)
+        by_dest: dict = {}
+        for gid, entry in batch.items():
+            div = entry.division
+            if div.leader_ctx is None:
+                if not entry.future.done():
+                    entry.future.set_exception(
+                        ReadIndexException("not leader"))
+                continue
+            conf = div.state.configuration
+            others = [p for p in conf.voting_peers()
+                      if p.id != div.member_id.peer_id]
+            if not others:
+                self._resolve(batch, gid)
+                continue
+            need[gid] = len(conf.voting_peers()) // 2 + 1 - 1  # minus self
+            acks[gid] = 0
+            log = div.state.log
+            prev = log.get_last_entry_term_index()
+            commit = log.get_last_committed_index()
+            for peer in others:
+                req = AppendEntriesRequest(
+                    RaftRpcHeader(div.member_id.peer_id, peer.id, gid),
+                    div.state.current_term, prev, (), commit)
+                by_dest.setdefault(peer.id, []).append((gid, req))
+
+        async def _send(dest, items) -> None:
+            env = AppendEnvelope(tuple(req for _, req in items))
+            try:
+                reply = await self.server.send_server_rpc(dest, env)
+            except Exception:
+                return
+            if reply is None or reply.status != 0 or not reply.items:
+                return
+            for (gid, _), item in zip(items, reply.items):
+                if item is None or gid not in need:
+                    continue
+                if item.result == AppendResult.SUCCESS \
+                        or item.result == AppendResult.INCONSISTENCY:
+                    acks[gid] += 1
+                    if acks[gid] >= need[gid]:
+                        need.pop(gid, None)
+                        self._resolve(batch, gid)
+
+        tasks = [asyncio.create_task(_send(dest, items))
+                 for dest, items in by_dest.items()]
+        if tasks:
+            try:
+                await asyncio.wait(tasks, timeout=self.timeout_s)
+            finally:
+                for t in tasks:
+                    t.cancel()
+        for gid in list(need):
+            entry = batch[gid]
+            if not entry.future.done():
+                entry.future.set_exception(ReadIndexException(
+                    f"leadership not confirmed: "
+                    f"{acks.get(gid, 0)} acks short of majority"))
+
+    def _resolve(self, batch: dict, gid) -> None:
+        entry = batch[gid]
+        if not entry.future.done():
+            entry.future.set_result(None)
+            self.confirmed += entry.waiters

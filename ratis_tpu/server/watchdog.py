@@ -57,8 +57,13 @@ KIND_STUCK_LANE = "stuck-lane"
 # health subcommand exits 1 on it).
 KIND_INJECTED_FAULT = "injected-fault"
 KIND_FAULT_RECOVERED = "fault-recovered"
+# Sustained overload (serving plane): admission control shedding above
+# the configured rate for a whole interval — bounded pending is working
+# as designed, but the operator should know the fleet is over capacity.
+KIND_OVERLOAD = "overload"
 KINDS = (KIND_COMMIT_STALL, KIND_ELECTION_CHURN, KIND_FOLLOWER_LAG,
-         KIND_STUCK_LANE, KIND_INJECTED_FAULT, KIND_FAULT_RECOVERED)
+         KIND_STUCK_LANE, KIND_INJECTED_FAULT, KIND_FAULT_RECOVERED,
+         KIND_OVERLOAD)
 
 # consecutive flat samples (with pending requests) before a commit-stall
 # event is journaled: one flat interval is ordinary queueing, two is not
@@ -109,6 +114,13 @@ class StallWatchdog:
         self._lane_full: dict = {}
         self._lane_stuck: set = set()
         self._last_commits = None  # engine commit_advances at last sample
+        # sustained-overload detection: shed total at last sample + an
+        # in-episode latch (one event per overload episode, not per
+        # saturated interval)
+        self.shed_rate_threshold = \
+            RaftServerConfigKeys.Serving.overload_shed_rate(p)
+        self._last_shed = None
+        self._overloaded = False
         info = MetricRegistryInfo(prefix=str(server.peer_id),
                                   application="ratis", component="server",
                                   name="watchdog")
@@ -257,6 +269,32 @@ class StallWatchdog:
                           f"(threshold {self.churn_threshold})")
         self._last_elections = elections
         self._check_stuck_lanes()
+        self._check_overload()
+
+    def _check_overload(self) -> None:
+        """Sustained overload: the admission controller's shed rate over
+        the last interval above raft.tpu.serving.overload.shed-rate.  One
+        event per episode; the episode closes once a whole interval
+        passes under threshold."""
+        serving = getattr(self.server, "serving", None)
+        if serving is None:
+            return
+        shed = serving.admission.shed_total
+        last = self._last_shed
+        self._last_shed = shed
+        if last is None:
+            return
+        rate = (shed - last) / max(self.interval_s, 1e-9)
+        if rate > self.shed_rate_threshold:
+            if not self._overloaded:
+                self._overloaded = True
+                self.emit(KIND_OVERLOAD, None,
+                          f"admission control shedding {rate:.0f} "
+                          f"requests/s (threshold "
+                          f"{self.shed_rate_threshold:.0f}/s); pending "
+                          f"budgets holding, clients told to back off")
+        else:
+            self._overloaded = False
 
     def _check_stuck_lanes(self) -> None:
         """Stuck-lane detection (round-9 append windows): a sender whose

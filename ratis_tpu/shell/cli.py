@@ -241,7 +241,8 @@ async def cmd_health(args) -> int:
               f"engine ticks={proc.get('engineTicks')} "
               f"occupancy={proc.get('laneOccupancyGroups'):.3f} | "
               f"pending={proc.get('pendingRequests')} "
-              f"lagMax={proc.get('followerLagMax')}")
+              f"lagMax={proc.get('followerLagMax')} "
+              f"shed={proc.get('shedRequests', 0)}")
         if proc.get("status") != "ok":
             rc = 1
         if proc.get("chaosActiveFaults"):
@@ -330,7 +331,8 @@ async def cmd_top(args) -> int:
                          for k, v in sorted(
                              merged.get("rates", {}).items())))
         print(f"{'PEER':<10} {'PID':<8} {'C/S':>9} {'ACK/S':>9} "
-              f"{'REW/S':>7} {'OCC':>6} {'PEND':>6} {'DIV':>6} {'EVT':>5}")
+              f"{'REW/S':>7} {'SHED/S':>7} {'OCC':>6} {'PEND':>6} "
+              f"{'DIV':>6} {'EVT':>5}")
         for pid, proc in sorted(procs.items()):
             addr = merged.get("addresses", {}).get(pid)
             if addr is not None and proc.get("seq", -1) >= 0:
@@ -344,7 +346,7 @@ async def cmd_top(args) -> int:
                 # counters each sample carries — true /timeseries deltas,
                 # independent of the server-side sampling cadence
                 dt = max(1e-6, now - p[0])
-                for k in ("commits", "acks", "rewinds"):
+                for k in ("commits", "acks", "rewinds", "shed"):
                     if k in totals and k in p[1]:
                         rates[f"{k}_per_s"] = round(
                             max(0, totals[k] - p[1][k]) / dt, 1)
@@ -354,6 +356,7 @@ async def cmd_top(args) -> int:
                   f"{rates.get('commits_per_s', 0):>9g} "
                   f"{rates.get('acks_per_s', 0):>9g} "
                   f"{rates.get('rewinds_per_s', 0):>7g} "
+                  f"{rates.get('shed_per_s', 0):>7g} "
                   f"{last.get('occupancy', 0):>6g} "
                   f"{last.get('pending', 0):>6g} "
                   f"{last.get('divisions', 0):>6g} "

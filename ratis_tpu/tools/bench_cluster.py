@@ -34,7 +34,8 @@ def _ephemeral_port() -> int:
 from ratis_tpu.conf import RaftProperties, RaftServerConfigKeys
 from ratis_tpu.models.counter import CounterStateMachine
 from ratis_tpu.protocol.exceptions import (LeaderNotReadyException,
-                                           NotLeaderException, RaftException)
+                                           NotLeaderException, RaftException,
+                                           ResourceUnavailableException)
 from ratis_tpu.protocol.group import RaftGroup
 from ratis_tpu.protocol.ids import ClientId, RaftGroupId, RaftPeerId
 from ratis_tpu.protocol.message import Message
@@ -1917,6 +1918,231 @@ async def run_stream_throughput_bench(streams: int, stream_mb: int,
             "stream_mb_per_s": round(
                 stats["bytes"] / max(elapsed, 1e-9) / (1 << 20), 2),
             "elapsed_s": round(elapsed, 2),
+        }
+
+
+async def run_zipf_fleet_bench(num_groups: int = 1024,
+                               clients: int = 10240,
+                               requests_per_client: int = 1,
+                               zipf_s: float = 1.1,
+                               concurrency: int = 512,
+                               batched: bool = True,
+                               transport: str = "tcp",
+                               num_servers: int = 3,
+                               loop_shards: int = 1,
+                               seed: int = 11,
+                               element_limit: int = 192,
+                               unsat_clients: int = 256) -> dict:
+    """Zipf client-fleet rung (serving plane, round 13): drive ``clients``
+    logical client connections whose home groups follow a zipf(s) law over
+    ``num_groups`` groups — the skewed-popularity regime admission control
+    exists for.  Admission is ON with a pending budget deliberately below
+    the fleet's offered concurrency, so the rung measures the serving
+    plane under genuine overload:
+
+    - writes/s and linearizable reads/s actually served,
+    - shed fraction (typed ResourceUnavailableException replies at
+      intake; clients honor the retry-after hint and try again),
+    - p99 write latency under overload vs an unsaturated baseline phase
+      run first at low concurrency (the "does backpressure keep the
+      served tail bounded" number),
+    - peak pending-budget occupancy (bounded-pending evidence), and
+    - the hot-group sketch's view of the skew (round-11 telemetry) vs
+      the analytic zipf top-group share.
+    """
+    import bisect
+    import random
+
+    from ratis_tpu.protocol.requests import read_request_type
+
+    keys = RaftServerConfigKeys.Serving
+    extra = {
+        RaftServerConfigKeys.Read.OPTION_KEY: "LINEARIZABLE",
+        RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY: "true",
+        RaftServerConfigKeys.Telemetry.ENABLED_KEY: "true",
+        RaftServerConfigKeys.Telemetry.INTERVAL_KEY: "250ms",
+        keys.ADMISSION_ENABLED_KEY: "true",
+        keys.PENDING_ELEMENT_LIMIT_KEY: str(element_limit),
+        keys.RETRY_AFTER_KEY: "40ms",
+    }
+    rng = random.Random(seed)
+    # zipf CDF over group ranks: rank r (0-based) carries weight (r+1)^-s;
+    # group 0 is the fleet's hot group by construction
+    weights = [(r + 1) ** -zipf_s for r in range(num_groups)]
+    total_w = sum(weights)
+    cdf, acc = [], 0.0
+    for w in weights:
+        acc += w
+        cdf.append(acc / total_w)
+    expected_top_share = weights[0] / total_w
+
+    async with _started_cluster(num_groups, batched, transport=transport,
+                                num_servers=num_servers,
+                                loop_shards=loop_shards,
+                                extra_props=extra) as cluster:
+        client = cluster.factory.new_client_transport(cluster.properties)
+
+        def shed_now() -> int:
+            return sum(s.serving.admission.shed_total
+                       for s in cluster.servers)
+
+        def admitted_now() -> int:
+            return sum(s.serving.admission.admitted_total
+                       for s in cluster.servers)
+
+        def pending_now() -> int:
+            return max(sum(s.serving.admission.pending_count)
+                       for s in cluster.servers)
+
+        async def one_op(client_id, gid, is_read, lat, stats) -> None:
+            server = cluster._leader_hint.get(gid, cluster.servers[0])
+            deadline = time.monotonic() + 60.0
+            t0 = time.monotonic()
+            while True:
+                req = RaftClientRequest(
+                    client_id, server.peer_id, gid,
+                    next(cluster._call_ids),
+                    Message.value_of(b"GET" if is_read else b"INCREMENT"),
+                    type=(read_request_type() if is_read
+                          else write_request_type()),
+                    timeout_ms=10_000.0)
+                try:
+                    reply = await client.send_request(server.address, req)
+                except (RaftException, asyncio.TimeoutError):
+                    reply = None
+                if reply is not None and reply.success:
+                    lat.append(time.monotonic() - t0)
+                    cluster._leader_hint[gid] = server
+                    return
+                if time.monotonic() > deadline:
+                    stats["failures"] += 1
+                    return
+                exc = reply.exception if reply is not None else None
+                if isinstance(exc, ResourceUnavailableException):
+                    # the typed overload reply: honor the retry-after hint
+                    stats["shed_seen"] += 1
+                    await asyncio.sleep(max(exc.retry_after_ms, 1) / 1e3)
+                elif isinstance(exc, NotLeaderException) \
+                        and exc.suggested_leader is not None:
+                    by_id = {s.peer_id: s for s in cluster.servers}
+                    server = by_id.get(exc.suggested_leader.id, server)
+                else:
+                    idx = cluster.servers.index(server)
+                    server = cluster.servers[(idx + 1) % len(cluster.servers)]
+                    await asyncio.sleep(0.01)
+
+        async def drive(n_clients: int, conc: int) -> dict:
+            sem = asyncio.Semaphore(conc)
+            stats = {"shed_seen": 0, "failures": 0, "pending_peak": 0}
+            write_lat: list[float] = []
+            read_lat: list[float] = []
+            homes = [bisect.bisect_left(cdf, rng.random())
+                     for _ in range(n_clients)]
+
+            async def fleet_client(i: int) -> None:
+                client_id = ClientId.random_id()
+                gid = cluster.groups[min(homes[i], num_groups - 1)].group_id
+                for _ in range(requests_per_client):
+                    async with sem:
+                        await one_op(client_id, gid, False, write_lat, stats)
+                    async with sem:
+                        await one_op(client_id, gid, True, read_lat, stats)
+
+            async def sample_pending() -> None:
+                while True:
+                    stats["pending_peak"] = max(stats["pending_peak"],
+                                                pending_now())
+                    await asyncio.sleep(0.025)
+
+            sampler = asyncio.ensure_future(sample_pending())
+            t0 = time.monotonic()
+            try:
+                await asyncio.gather(*(fleet_client(i)
+                                       for i in range(n_clients)))
+            finally:
+                sampler.cancel()
+            elapsed = time.monotonic() - t0
+            write_lat.sort()
+            read_lat.sort()
+            nw, nr = len(write_lat), len(read_lat)
+            return {
+                "elapsed": elapsed, "writes_ok": nw, "reads_ok": nr,
+                "p99_s": write_lat[min(nw - 1, (nw * 99) // 100)] if nw
+                else None,
+                "read_p99_s": read_lat[min(nr - 1, (nr * 99) // 100)] if nr
+                else None,
+                **stats,
+            }
+
+        # phase 1 — unsaturated baseline: a small fleet at low concurrency
+        # (well under the pending budget), the denominator for the
+        # overload-p99 ratio
+        unsat = await drive(unsat_clients, max(8, element_limit // 8))
+        # phase 2 — the fleet: offered concurrency deliberately above the
+        # pending budget, so intake sheds and clients back off
+        shed0, adm0 = shed_now(), admitted_now()
+        sweeps0 = sum(s.serving.read_batch.sweeps for s in cluster.servers
+                      if s.serving.read_batch is not None)
+        fleet = await drive(clients, concurrency)
+        shed = shed_now() - shed0
+        admitted = admitted_now() - adm0
+        sweeps = sum(s.serving.read_batch.sweeps for s in cluster.servers
+                     if s.serving.read_batch is not None) - sweeps0
+
+        total_ops = clients * requests_per_client * 2
+        if fleet["failures"] > max(16, total_ops // 50):
+            raise TimeoutError(
+                f"{fleet['failures']}/{total_ops} fleet ops failed outright "
+                f"— shedding must surface typed replies, not timeouts")
+
+        # the hot-group sketch's view of the skew vs the analytic share
+        from ratis_tpu.metrics.aggregate import merge_hotgroups
+        tel = [s.telemetry for s in cluster.servers
+               if s.telemetry is not None]
+        hot = merge_hotgroups([t.hotgroups_info() for t in tel], n=4) \
+            if tel else {"groups": []}
+        top = hot["groups"][0] if hot["groups"] else None
+        p99_unsat = unsat["p99_s"]
+        p99_fleet = fleet["p99_s"]
+        return {
+            "clients": clients,
+            "groups": num_groups,
+            "zipf_s": zipf_s,
+            "writes_ok": fleet["writes_ok"],
+            "reads_ok": fleet["reads_ok"],
+            "failures": fleet["failures"],
+            "elapsed_s": round(fleet["elapsed"], 3),
+            "writes_per_sec": round(fleet["writes_ok"] / fleet["elapsed"], 1),
+            "reads_per_sec": round(fleet["reads_ok"] / fleet["elapsed"], 1),
+            # shed fraction of everything that reached intake (server
+            # truth) + the client-observed typed replies (retry loop saw
+            # them, honored retry-after, and got through)
+            "shed": shed,
+            "admitted": admitted,
+            "shed_frac": round(shed / max(1, shed + admitted), 4),
+            "shed_seen_by_clients": fleet["shed_seen"],
+            "p99_ms": round(p99_fleet * 1e3, 2) if p99_fleet else None,
+            "read_p99_ms": (round(fleet["read_p99_s"] * 1e3, 2)
+                            if fleet["read_p99_s"] else None),
+            "p99_unsat_ms": round(p99_unsat * 1e3, 2) if p99_unsat else None,
+            "overload_p99_ratio": (round(p99_fleet / p99_unsat, 2)
+                                   if p99_fleet and p99_unsat else None),
+            "pending_peak": fleet["pending_peak"],
+            "pending_limit": element_limit,
+            # batched readIndex amortization: confirmation sweeps per
+            # linearizable read served (lease fast path + batching keep
+            # this far under 1; acceptance bound is < 0.1 at 1024 groups)
+            "confirm_sweeps_per_read": round(
+                sweeps / max(1, fleet["reads_ok"]), 4),
+            "hot_share": top["share_min"] if top else 0.0,
+            "hot_group": top["group"] if top else None,
+            "hot_group_expected": str(cluster.groups[0].group_id),
+            "expected_top_share": round(expected_top_share, 4),
+            "election_convergence_s": round(
+                cluster.election_convergence_s, 2),
+            "mode": "batched" if batched else "scalar",
+            "transport": transport,
+            "peers": num_servers,
         }
 
 

@@ -1,0 +1,447 @@
+"""Serving plane: admission control, batched linearizable reads,
+overload behavior.
+
+Covers the round-13 contracts — typed RESOURCE_EXHAUSTED-style shed
+replies with a retry-after hint crossing the wire intact, pending-budget
+accounting that always drains back to zero, the batched readIndex
+confirmation sweep amortizing the per-group heartbeat round, read
+linearizability under randomized write/read interleavings on both the
+lease and confirmation paths (and across a leadership change), the
+overload chaos scenario's SLOs, and the watchdog's sustained-overload
+event."""
+
+import asyncio
+import random
+
+import pytest
+
+from ratis_tpu.conf import RaftServerConfigKeys
+from ratis_tpu.protocol.exceptions import (ResourceUnavailableException,
+                                           exception_from_wire,
+                                           exception_to_wire)
+from ratis_tpu.protocol.ids import ClientId
+from ratis_tpu.protocol.message import Message
+from ratis_tpu.protocol.requests import (RaftClientRequest,
+                                         read_request_type,
+                                         write_request_type)
+from ratis_tpu.server.read import WriteIndexCache
+from tests.minicluster import MiniCluster, fast_properties, run_with_new_cluster
+
+S = RaftServerConfigKeys.Serving
+
+
+def _admission_props(element_limit: int = 1, retry_after: str = "20ms",
+                     linearizable: bool = False, lease: bool = False):
+    p = fast_properties()
+    p.set(S.ADMISSION_ENABLED_KEY, "true")
+    p.set(S.PENDING_ELEMENT_LIMIT_KEY, str(element_limit))
+    p.set(S.RETRY_AFTER_KEY, retry_after)
+    if linearizable:
+        p.set(RaftServerConfigKeys.Read.OPTION_KEY, "LINEARIZABLE")
+    if lease:
+        p.set_boolean(RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY,
+                      True)
+    return p
+
+
+async def _read(cluster: MiniCluster, server_id=None, attempts: int = 40):
+    """A read through the MiniCluster failover loop, retrying the
+    transient failure replies it surfaces directly: readIndex rejections
+    around leadership/term-commit windows, and admission sheds when the
+    test budget is deliberately tiny."""
+    last = None
+    for _ in range(attempts):
+        if server_id is None:
+            r = await cluster.send_read()
+        else:
+            r = await cluster.send(b"GET", read_request_type(),
+                                   server_id=server_id)
+        if r.success:
+            return r
+        last = r
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"read kept failing: {last.exception}")
+
+
+# --------------------------------------------------- write-index cache
+
+def test_write_index_cache_sweep_evicts_expired():
+    """The slow-tick sweep drops EVERY expired entry — the lazy get()
+    path only evicts keys that are queried again, so a fleet of
+    transient client ids would otherwise accrete one entry each."""
+    cache = WriteIndexCache(expiry_s=10.0)
+    t0 = 1000.0
+    import time as _time
+    real = _time.monotonic
+    _time.monotonic = lambda: t0
+    try:
+        for i in range(8):
+            cache.put(f"c{i}".encode(), i)
+        assert len(cache) == 8
+        # nothing expired yet
+        assert cache.sweep(now=t0 + 5.0) == 0
+        assert len(cache) == 8
+        # refresh half at t+8; the stale half expires at t+19
+        for i in range(4):
+            t0 = 1008.0
+            cache.put(f"c{i}".encode(), 100 + i)
+        assert cache.sweep(now=1000.0 + 11.0) == 4
+        assert len(cache) == 4
+        assert cache.get(b"c0") == 100
+        assert cache.get(b"c7") == -1
+        # the refreshed half expires too, and sweep returns the count
+        assert cache.sweep(now=1008.0 + 11.0) == 4
+        assert len(cache) == 0
+    finally:
+        _time.monotonic = real
+
+
+# ------------------------------------------------- typed overload reply
+
+def test_resource_unavailable_retry_after_crosses_wire():
+    e = ResourceUnavailableException("s0 shard 0 over pending budget",
+                                     retry_after_ms=160)
+    d = exception_to_wire(e)
+    back = exception_from_wire(d)
+    assert isinstance(back, ResourceUnavailableException)
+    assert back.retry_after_ms == 160
+    assert "over pending budget" in str(back)
+    # the zero hint stays off the wire (and decodes to 0)
+    plain = exception_from_wire(
+        exception_to_wire(ResourceUnavailableException("x")))
+    assert plain.retry_after_ms == 0
+
+
+def test_admission_sheds_typed_replies_and_releases_budget():
+    """Overflowing the pending budget sheds with a typed reply carrying a
+    retry-after hint; admitted requests apply exactly once; the budget
+    drains back to zero afterwards (no ticket leaks on either the
+    immediate- or deferred-reply path)."""
+
+    async def body(cluster: MiniCluster):
+        from ratis_tpu.protocol.exceptions import (LeaderNotReadyException,
+                                                   NotLeaderException)
+        client = cluster.factory.new_client_transport(cluster.properties)
+        client_id = ClientId.random_id()
+        ok, shed = [], []
+        # a burst can race a leadership change (the one admitted write
+        # fails NotLeader); re-resolve the leader and retry the burst
+        for attempt in range(4):
+            leader = await cluster.wait_for_leader()
+            server = cluster.servers[leader.member_id.peer_id]
+
+            async def one(i: int):
+                req = RaftClientRequest(client_id, server.peer_id,
+                                        cluster.group.group_id,
+                                        1000 + attempt * 100 + i,
+                                        Message.value_of(b"INCREMENT"),
+                                        type=write_request_type())
+                return await client.send_request(server.address, req)
+
+            replies = await asyncio.gather(*(one(i) for i in range(24)))
+            ok += [r for r in replies if r.success]
+            for r in replies:
+                if r.success:
+                    continue
+                if isinstance(r.exception, (NotLeaderException,
+                                            LeaderNotReadyException)):
+                    continue  # leadership raced the burst; not a shed
+                shed.append(r)
+            if ok and shed:
+                break
+        assert ok, "every write was shed — budget never admits"
+        assert shed, "concurrent writes against a 1-element budget " \
+                     "never shed"
+        for r in shed:
+            assert isinstance(r.exception, ResourceUnavailableException), r
+            assert r.exception.retry_after_ms >= 20
+        admissions = [s.serving.admission for s in cluster.servers.values()]
+        assert sum(a.shed_total for a in admissions) == len(shed)
+        assert sum(a.admitted_total for a in admissions) >= len(ok)
+        # exactly once, no silent drops: the counter equals the ack count
+        await cluster.wait_applied(max(r.log_index for r in ok),
+                                   divisions=[leader])
+        read = await _read(cluster)
+        assert read.message.content == str(len(ok)).encode()
+        # budget fully released once the dust settles
+        for a in admissions:
+            assert sum(a.pending_count) == 0
+            assert sum(a.pending_bytes) == 0
+        # the health endpoint surfaces the serving plane
+        h = server.health_info()
+        assert h["serving"]["admissionEnabled"] is True
+        assert h["serving"]["shedTotal"] == server.serving.admission.shed_total
+        assert h["serving"]["pendingCount"] == 0
+
+    run_with_new_cluster(3, body, properties=_admission_props(1))
+
+
+def test_client_retry_loop_honors_retry_after():
+    """The full RaftClient absorbs shed replies: it backs off by the
+    server's hint and retries, so a burst against a tiny budget still
+    completes every write — the server shed plenty, the caller saw none
+    of it."""
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        async with cluster.new_client() as client:
+            replies = await asyncio.gather(
+                *(client.io().send(b"INCREMENT") for _ in range(12)))
+        assert all(r.success for r in replies)
+        shed = sum(s.serving.admission.shed_total
+                   for s in cluster.servers.values())
+        assert shed > 0, "12 pipelined writes never tripped the 1-element " \
+                         "budget — admission was not exercised"
+        read = await _read(cluster)
+        assert read.message.content == b"12"
+
+    run_with_new_cluster(3, body, properties=_admission_props(1))
+
+
+# ---------------------------------------------- batched readIndex sweep
+
+def test_batched_confirmation_amortizes_concurrent_reads():
+    """40 concurrent linearizable reads (no lease) ride a handful of
+    confirmation sweeps, not 40 scalar heartbeat rounds."""
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        server = cluster.servers[leader.member_id.peer_id]
+        w = await cluster.send_write()
+        await cluster.wait_applied(w.log_index, divisions=[leader])
+        sched = server.serving.read_batch
+        assert sched is not None
+        sweeps0, confirmed0 = sched.sweeps, sched.confirmed
+        client = cluster.factory.new_client_transport(cluster.properties)
+        client_id = ClientId.random_id()
+
+        async def one_read(i: int):
+            req = RaftClientRequest(client_id, server.peer_id,
+                                    cluster.group.group_id, 5000 + i,
+                                    Message.value_of(b"GET"),
+                                    type=read_request_type())
+            return await client.send_request(server.address, req)
+
+        replies = await asyncio.gather(*(one_read(i) for i in range(40)))
+        assert all(r.success for r in replies), \
+            [str(r.exception) for r in replies if not r.success][:3]
+        assert all(r.message.content == b"1" for r in replies)
+        sweeps = sched.sweeps - sweeps0
+        confirmed = sched.confirmed - confirmed0
+        assert confirmed == 40, confirmed
+        # the acceptance shape: rounds per read well under 1 (the scalar
+        # path would have fired 40)
+        assert sweeps <= 4, f"{sweeps} sweeps for 40 concurrent reads"
+
+    run_with_new_cluster(3, body,
+                         properties=_admission_props(64,
+                                                     linearizable=True))
+
+
+@pytest.mark.chaos
+def test_cross_group_sweep_batches_distinct_groups():
+    """Reads pending on DIFFERENT groups of one shard share a sweep: the
+    confirmation round goes out as one zero-entry envelope per
+    destination, not one per group."""
+    from ratis_tpu.chaos.cluster import ChaosCluster, chaos_properties
+
+    async def main():
+        props = chaos_properties(1, seed=3)
+        props.set(RaftServerConfigKeys.Read.OPTION_KEY, "LINEARIZABLE")
+        cluster = ChaosCluster(3, num_groups=8, properties=props, seed=3)
+        await cluster.start()
+        try:
+            for g in cluster.groups:
+                assert await cluster.write(g.group_id)
+            servers = list(cluster.servers.values())
+            sweeps0 = sum(s.serving.read_batch.sweeps for s in servers)
+
+            async def one_read(g):
+                async with cluster.new_client(group=g) as client:
+                    return await client.io().send_read_only(b"GET")
+
+            replies = await asyncio.gather(
+                *(one_read(g) for g in cluster.groups))
+            assert all(r.success for r in replies)
+            assert all(r.message.content == b"1" for r in replies)
+            sweeps = sum(s.serving.read_batch.sweeps
+                         for s in servers) - sweeps0
+            assert sweeps <= 4, \
+                f"{sweeps} sweeps for 8 cross-group concurrent reads"
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------ read linearizability
+
+@pytest.mark.parametrize("lease", [False, True],
+                         ids=["confirmation", "lease"])
+def test_reads_never_older_than_acked_writes(lease):
+    """Randomized interleaving: a linearizable read submitted AFTER a
+    write was acked must observe at least that write — on both the
+    confirmation path and the lease fast path."""
+
+    async def body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        rng = random.Random(42 + int(lease))
+        acked = 0
+        violations: list[tuple[int, int]] = []
+
+        async def writer():
+            nonlocal acked
+            for _ in range(25):
+                r = await cluster.send_write()
+                assert r.success
+                acked += 1
+                await asyncio.sleep(rng.random() * 0.004)
+
+        async def reader():
+            for _ in range(15):
+                floor = acked
+                # the floor is captured BEFORE the first submission, so
+                # a transient-failure retry can only see MORE writes —
+                # it never weakens the check
+                r = await _read(cluster)
+                seen = int(r.message.content)
+                if seen < floor:
+                    violations.append((floor, seen))
+                await asyncio.sleep(rng.random() * 0.004)
+
+        await asyncio.gather(writer(), reader(), reader(), reader())
+        assert not violations, \
+            f"stale linearizable reads (acked_floor, seen): {violations}"
+        assert acked == 25
+
+    props = fast_properties()
+    props.set(RaftServerConfigKeys.Read.OPTION_KEY, "LINEARIZABLE")
+    if lease:
+        props.set_boolean(RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY,
+                          True)
+    run_with_new_cluster(3, body, properties=props)
+
+
+def test_linearizable_reads_across_leadership_change():
+    """A leadership change invalidates the old leader's lease: after the
+    old leader is partitioned away and a new one elected, reads reflect
+    every write acked by EITHER leader, and the deposed leader steps
+    down on heal instead of serving from its stale lease."""
+
+    async def body(cluster: MiniCluster):
+        old = await cluster.wait_for_leader()
+        for _ in range(3):
+            assert (await cluster.send_write()).success
+        old_id = old.member_id.peer_id
+        for d in cluster.divisions():
+            pid = d.member_id.peer_id
+            if pid != old_id:
+                cluster.network.block(old_id, pid)
+                cluster.network.block(pid, old_id)
+        # a new leader must rise among the connected majority
+        new = None
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            live = [d for d in cluster.divisions()
+                    if d.member_id.peer_id != old_id and d.is_leader()]
+            if live:
+                new = live[0]
+                break
+            await asyncio.sleep(0.02)
+        assert new is not None, "no new leader after partitioning the old"
+        new_id = new.member_id.peer_id
+        for _ in range(2):
+            r = await cluster.send(b"INCREMENT", write_request_type(),
+                                   server_id=new_id)
+            assert r.success
+        # a read submitted after 5 acked writes sees all 5 — the new
+        # leader's readIndex covers both reigns
+        r = await _read(cluster, server_id=new_id)
+        assert r.message.content == b"5"
+        cluster.network.unblock_all()
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while asyncio.get_event_loop().time() < deadline:
+            if not old.is_leader():
+                break
+            await asyncio.sleep(0.02)
+        assert not old.is_leader(), \
+            "deposed leader kept leadership (and its lease) after heal"
+        r = await _read(cluster)
+        assert int(r.message.content) >= 5
+
+    props = fast_properties()
+    props.set(RaftServerConfigKeys.Read.OPTION_KEY, "LINEARIZABLE")
+    props.set_boolean(RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY,
+                      True)
+    run_with_new_cluster(3, body, properties=props)
+
+
+# ------------------------------------------------- overload under chaos
+
+@pytest.mark.chaos
+def test_overload_shed_scenario_slos():
+    """The overload_shed scenario: degraded links push a 10-writer burst
+    past a 2-element budget.  SLOs — zero lost acks, exactly-once apply,
+    shed requests all got typed replies (client timeouts forbidden),
+    and shedding actually happened."""
+    from ratis_tpu.chaos.cluster import ChaosCluster, chaos_properties
+    from ratis_tpu.chaos.scenario import run_scenario
+    from ratis_tpu.chaos.scenarios import build_scenario
+
+    async def main():
+        props = chaos_properties(1, seed=5)
+        props.set(S.ADMISSION_ENABLED_KEY, "true")
+        props.set(S.PENDING_ELEMENT_LIMIT_KEY, "2")
+        props.set(S.RETRY_AFTER_KEY, "20ms")
+        cluster = ChaosCluster(3, 1, properties=props, seed=5)
+        await cluster.start()
+        try:
+            sc = build_scenario("overload_shed", 5,
+                                {"convergence_s": 30.0, "recovery_s": 60.0,
+                                 "min_acked": 10, "writers": 10,
+                                 "expect_shed": True})
+            res = await run_scenario(cluster, sc)
+            assert res.passed, (
+                f"[seed 5] overload_shed failed: {res.error}\n"
+                f"journal: {res.journal}")
+            assert res.checks["shed_total"] > 0
+            assert res.checks["client_timeouts"] == 0
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+def test_watchdog_emits_one_overload_event_per_episode():
+    """A shed rate above raft.tpu.serving.overload.shed-rate journals ONE
+    overload event for the whole episode; a quiet interval closes it."""
+    from ratis_tpu.server.watchdog import KIND_OVERLOAD, StallWatchdog
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        server = cluster.servers[leader.member_id.peer_id]
+        wd = StallWatchdog(server, interval_s=1.0)
+        try:
+            wd.sample()  # baseline: primes _last_shed
+            server.serving.admission.shed_total += 100
+            wd.sample()
+            events = [e for e in wd.events() if e["kind"] == KIND_OVERLOAD]
+            assert len(events) == 1, wd.events()
+            assert "shedding" in events[0]["detail"]
+            # still saturated: same episode, no second event
+            server.serving.admission.shed_total += 100
+            wd.sample()
+            assert sum(1 for e in wd.events()
+                       if e["kind"] == KIND_OVERLOAD) == 1
+            # a quiet interval closes the episode; the next burst reopens
+            wd.sample()
+            server.serving.admission.shed_total += 100
+            wd.sample()
+            assert sum(1 for e in wd.events()
+                       if e["kind"] == KIND_OVERLOAD) == 2
+        finally:
+            await wd.close()
+
+    props = _admission_props(4)
+    props.set(S.OVERLOAD_SHED_RATE_KEY, "10.0")
+    run_with_new_cluster(3, body, properties=props)

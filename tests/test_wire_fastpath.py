@@ -449,6 +449,8 @@ def test_bench_summary_line_fits_driver_window():
                      reads_stale=99999),
         snapcatch=rung(catchup_s=9999.99, installs=10240,
                        cps_before=123456.8),
+        zipf=rung(writes_per_sec=123456.8, reads_per_sec=123456.8,
+                  shed_frac=0.9999),
         win_sweep={str(d): [123456.8, 99999.99, 0.9999]
                    for d in (1, 4, 16)},
         chaos={"passed": 9, "total": 9, "worst_reelect_s": 9999.999,
@@ -472,6 +474,9 @@ def test_bench_summary_line_fits_driver_window():
     assert parsed["secondary"]["mix_5ms"][2] == 1.0
     assert parsed["secondary"]["readmix"][1] == 123456.8
     assert parsed["secondary"]["snap_1024"][1] == 10240
+    # round-12 zipf fleet rung: [writes/s, reads/s, shed frac, p99 ms]
+    assert parsed["secondary"]["zipf"] == [
+        123456.8, 123456.8, 0.9999, 99999.99]
     # observability keys: [engine occupancy, watchdog event count,
     # reply-plane scheduling hops per commit (round-8 fan-out collapse),
     # append-window occupancy (round-9 pipelined windows), the round-11
@@ -485,4 +490,7 @@ def test_bench_summary_line_fits_driver_window():
     # recovery-throughput fraction, injected-fault event records]
     assert parsed["secondary"]["chaos"] == [9, 9, 9999.999, 99.999,
                                                  99999]
-    assert "cps" in parsed["secondary"]["grpc_1024"]
+    # compact list forms: grpc_1024 = [cps, p99, scalar cps, s256 cps],
+    # mesh_10240 = [cps, spread, sim cps, sim spread]
+    assert parsed["secondary"]["grpc_1024"][0] == 123456.8
+    assert len(parsed["secondary"]["mesh_10240"]) == 4
