@@ -882,9 +882,17 @@ def _write_definition() -> None:
         "continuous-telemetry overhead pair on the headline TCP rung "
         "([sampler-on c/s, sampler-off c/s, overhead fraction]; "
         "raft.tpu.telemetry.* — the <=2%% docs/perf.md bound re-measured "
-        "every run), and the headline hot-group skew (top group's "
+        "every run), the headline hot-group skew (top group's "
         "GUARANTEED share of sketched commit load, (count-err)/total; "
-        "uniform load reads ~0, genuine zipf skew the true share)].\n"
+        "uniform load reads ~0, genuine zipf skew the true share), and "
+        "the round-14 lag-ledger cost pair [sampler pass loop-blocking "
+        "ms (thread-CPU best-of-3 of a forced ledger-fed pass — O(1) "
+        "python; the device pass runs on XLA's pool with the GIL "
+        "released), device ledger fetch wall p50 ms]; the retired "
+        "per-division python walk (which holds the GIL for its whole "
+        "linear cost) is measured back-to-back on the same live state "
+        "as telemetry.walk_pass_ms inside the rung result (docs/perf.md "
+        "round 14's >=5x bound)].\n"
         "- secondary.win_sweep: round-9 window-depth sweep on the "
         "headline TCP rung, depth -> [commits/s, p99 ms, window "
         "occupancy]; depth 1 is the latched stop-and-wait-per-group "
@@ -1024,7 +1032,16 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                     # sketched commit load (uniform 1024-group load
                     # reads ~1/1024; the zipf serving rung will not)
                     ((tel_on or {}).get("telemetry", {})
-                     .get("hot_share", 0.0))],
+                     .get("hot_share", 0.0)),
+                    # round-14 lag-ledger cost pair on the sampler-on
+                    # rung: [sampler pass p50 ms (ledger-fed), device
+                    # ledger fetch p50 ms] — the retired python walk's
+                    # back-to-back cost rides in the rung's own
+                    # telemetry.walk_pass_ms for the >=5x evidence
+                    [((tel_on or {}).get("telemetry", {})
+                      .get("sampler_pass_ms", 0.0)),
+                     ((tel_on or {}).get("telemetry", {})
+                      .get("ledger_fetch_ms", 0.0))]],
             # window-depth sweep: depth -> [c/s, p99 ms, occupancy]
             "win_sweep": win_sweep or {},
             "scalar_cps": _median(scalar_cps),

@@ -36,7 +36,8 @@ LOG = logging.getLogger(__name__)
 DEFAULT_CAMPAIGN = ("partition_minority", "partition_leader",
                     "asymmetric_partition", "link_degraded",
                     "crash_restart_follower", "crash_restart_leader",
-                    "leader_churn_storm", "slow_follower")
+                    "leader_churn_storm", "slow_follower",
+                    "grey_follower")
 DURABLE_EXTRA = ("slow_disk", "shared_log_tail_loss")
 
 

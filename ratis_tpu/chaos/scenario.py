@@ -406,6 +406,7 @@ class ScenarioRunner:
         res = self.result
         link_faults().reseed(sc.seed)
         self._arm_injections()
+        self._arm_grey()
         writers = _Writers(self.cluster, sc.config,
                            tag=f"{sc.name}.{sc.seed}.")
         # a quiesced start anchors the counter-delta oracle (and keeps a
@@ -492,6 +493,7 @@ class ScenarioRunner:
         finally:
             link_faults().heal_all()
             self._disarm_injections()
+            self._disarm_grey()
             await writers.stop()
             for victim in list(self._killed):
                 self._killed.remove(victim)
@@ -600,6 +602,81 @@ class ScenarioRunner:
                 (f"[seed {seed}] {writers.timeouts} client timeout(s) "
                  f"under overload: shed requests must get typed replies, "
                  f"not silent drops")
+        if sc.config.get("expect_grey"):
+            self._verify_grey()
+
+    # ------------------------------------------------- grey-follower SLO
+
+    def _arm_grey(self) -> None:
+        """Retune the lag ledger + grey detector for the scenario's write
+        rates (a latency fault of a few hundred ms puts a follower a
+        handful of entries behind, not the production default of 64) and
+        capture per-server event baselines; restored in _disarm_grey."""
+        cfg = self.scenario.config
+        if not cfg.get("expect_grey"):
+            return
+        self._grey_saved: dict = {}
+        self._grey_base: dict = {}
+        for name, srv in self.cluster.servers.items():
+            wd = srv.watchdog
+            if wd is None:
+                continue
+            led = srv.engine.ledger
+            self._grey_saved[name] = (
+                led.lag_threshold, led.up_window_ms, wd.grey_fraction,
+                wd.grey_min_groups, wd.grey_rounds)
+            led.lag_threshold = int(cfg.get("grey_lag_entries", 2))
+            led.up_window_ms = int(cfg.get("grey_up_window_ms", 8000))
+            wd.grey_fraction = float(cfg.get("grey_fraction", 0.5))
+            wd.grey_min_groups = int(cfg.get("grey_min_groups", 2))
+            wd.grey_rounds = int(cfg.get("grey_rounds", 1))
+            self._grey_base[name] = wd.last_seq
+
+    def _disarm_grey(self) -> None:
+        for name, saved in getattr(self, "_grey_saved", {}).items():
+            srv = self.cluster.servers.get(name)
+            if srv is None or srv.watchdog is None:
+                continue
+            led = srv.engine.ledger
+            (led.lag_threshold, led.up_window_ms,
+             srv.watchdog.grey_fraction, srv.watchdog.grey_min_groups,
+             srv.watchdog.grey_rounds) = saved
+        self._grey_saved = {}
+
+    def _verify_grey(self) -> None:
+        """The grey SLO: at least one grey-follower event during the
+        fault window, every one paired with a grey-recovered close.  A
+        forced watchdog pass per server first — writers are stopped and
+        links healed, so the pass deterministically closes any episode
+        still open instead of racing the background cadence."""
+        from ratis_tpu.server.watchdog import (KIND_GREY_FOLLOWER,
+                                               KIND_GREY_RECOVERED)
+        seed = self.scenario.seed
+        grey, recovered = [], []
+        for name, srv in self.cluster.servers.items():
+            wd = srv.watchdog
+            if wd is None:
+                continue
+            try:
+                wd.sample()
+            except Exception:
+                LOG.exception("forced watchdog pass on %s failed", name)
+            base = self._grey_base.get(name, -1)
+            for e in wd.events(since=base):
+                if e["kind"] == KIND_GREY_FOLLOWER:
+                    grey.append(e)
+                elif e["kind"] == KIND_GREY_RECOVERED:
+                    recovered.append(e)
+        self.result.checks["grey_events"] = len(grey)
+        self.result.checks["grey_recovered"] = len(recovered)
+        assert grey, \
+            (f"[seed {seed}] grey scenario raised no grey-follower "
+             f"event: the ledger detector missed a slow-but-alive peer")
+        rec_ids = {e.get("fault") for e in recovered}
+        unpaired = [e for e in grey if e.get("fault") not in rec_ids]
+        assert not unpaired, \
+            (f"[seed {seed}] {len(unpaired)} grey episode(s) never "
+             f"closed: {[e['fault'] for e in unpaired]}")
 
 
 async def run_scenario(cluster, scenario: Scenario,

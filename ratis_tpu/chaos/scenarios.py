@@ -275,6 +275,32 @@ def _overload_shed(rng: random.Random, cfg: dict) -> tuple:
             make_step(t + hold, "heal"))
 
 
+@_scenario("grey_follower")
+def _grey_follower(rng: random.Random, cfg: dict) -> tuple:
+    """Grey failure (the lag-ledger detector's reason to exist): heavy
+    latency + jitter on ONE follower's links, zero drop — every link
+    stays up and acking, quorum commits through the other follower, and
+    the victim silently falls behind on every group at once.  The run
+    must raise KIND_GREY_FOLLOWER (paired with its grey-recovered close
+    after the heal) on top of the usual zero-lost-acks / exactly-once
+    oracle.  ``expect_grey`` arms the runner: detector thresholds are
+    retuned live for the scenario's write rates (grey_lag_entries /
+    grey_fraction / grey_min_groups / grey_rounds / grey_up_window_ms
+    in the config override the armed values) and restored afterwards.
+    Load is concentrated (``active_groups``) so per-group commit deltas
+    stay visibly nonzero within each ledger pass — an idle group's links
+    never count as active and can never vote grey."""
+    cfg["expect_grey"] = True
+    cfg["active_groups"] = min(int(cfg.get("active_groups", 8) or 8), 8)
+    hold = _hold(cfg, round(rng.uniform(2.5, 3.5), 2))
+    t = _WARM_S + rng.uniform(0, 0.3)
+    return (make_step(t, "link", "follower:0",
+                      latency_ms=round(rng.uniform(250, 400), 1),
+                      jitter_ms=round(rng.uniform(40, 80), 1),
+                      drop_rate=0.0),
+            make_step(t + hold, "heal"))
+
+
 @_scenario("window_crash")
 def _window_crash(rng: random.Random, cfg: dict) -> tuple:
     """Round-9 window-protocol recovery: slow a follower so depth>1

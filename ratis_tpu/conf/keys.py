@@ -635,6 +635,69 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Serving.OVERLOAD_SHED_RATE_KEY,
                 RaftServerConfigKeys.Serving.OVERLOAD_SHED_RATE_DEFAULT)
 
+    class Lag:
+        """Lag & health ledger (ratis_tpu.engine.ledger; reference analog:
+        RaftServerMetrics' per-follower lag gauges on the Metrics SPI,
+        here batched over the ``[G, P]`` arrays into one fused pass per
+        telemetry tick).  ``threshold`` is the follower-lag line in
+        entries-behind-commit shared by the watchdog detector and the
+        grey classifier; ``up-window`` separates *grey* (slow but acking)
+        from *down* (not acking at all).  The ``grey.*`` knobs shape the
+        grey-follower episode detector: a peer is grey when at least
+        ``grey.fraction`` of its active links (up links of groups that
+        advanced commit this pass, at least ``grey.min-groups`` of them)
+        are past the threshold for ``grey.rounds`` consecutive watchdog
+        samples while none of its links are down."""
+
+        THRESHOLD_KEY = "raft.tpu.lag.threshold"
+        THRESHOLD_DEFAULT = 64
+        UP_WINDOW_KEY = "raft.tpu.lag.up-window"
+        UP_WINDOW_DEFAULT = TimeDuration.valueOf("3s")
+        GREY_FRACTION_KEY = "raft.tpu.lag.grey.fraction"
+        GREY_FRACTION_DEFAULT = 0.6
+        GREY_MIN_GROUPS_KEY = "raft.tpu.lag.grey.min-groups"
+        GREY_MIN_GROUPS_DEFAULT = 4
+        GREY_ROUNDS_KEY = "raft.tpu.lag.grey.rounds"
+        GREY_ROUNDS_DEFAULT = 2
+        # laggard-group list size in GET /lag (and shell lag)
+        TOP_GROUPS_KEY = "raft.tpu.lag.top-groups"
+        TOP_GROUPS_DEFAULT = 8
+
+        @staticmethod
+        def threshold(p: RaftProperties) -> int:
+            return p.get_int(RaftServerConfigKeys.Lag.THRESHOLD_KEY,
+                             RaftServerConfigKeys.Lag.THRESHOLD_DEFAULT)
+
+        @staticmethod
+        def up_window(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Lag.UP_WINDOW_KEY,
+                RaftServerConfigKeys.Lag.UP_WINDOW_DEFAULT)
+
+        @staticmethod
+        def grey_fraction(p: RaftProperties) -> float:
+            return p.get_float(
+                RaftServerConfigKeys.Lag.GREY_FRACTION_KEY,
+                RaftServerConfigKeys.Lag.GREY_FRACTION_DEFAULT)
+
+        @staticmethod
+        def grey_min_groups(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Lag.GREY_MIN_GROUPS_KEY,
+                RaftServerConfigKeys.Lag.GREY_MIN_GROUPS_DEFAULT)
+
+        @staticmethod
+        def grey_rounds(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Lag.GREY_ROUNDS_KEY,
+                RaftServerConfigKeys.Lag.GREY_ROUNDS_DEFAULT)
+
+        @staticmethod
+        def top_groups(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Lag.TOP_GROUPS_KEY,
+                RaftServerConfigKeys.Lag.TOP_GROUPS_DEFAULT)
+
     class Chaos:
         """Chaos campaign subsystem (ratis_tpu.chaos; reference analogs:
         RaftExceptionBaseTest, the kill/restart suites over simulated RPC,

@@ -300,6 +300,11 @@ class QuorumEngine:
         self._m = EngineMetrics(
             self, name or f"engine-{id(self):x}")
         self.metrics = _EngineMetricsView(self._m)
+        # Lag & health ledger over the same host mirrors (one fused pass +
+        # one fetch per telemetry tick; engine/ledger.py).  Lazy import:
+        # the engine module must stay importable without jax.
+        from ratis_tpu.engine.ledger import LagLedger
+        self.ledger = LagLedger(self, name or f"engine-{id(self):x}")
         # Cross-shard intake safety (raft.tpu.server.loop-shards): divisions
         # pinned to worker event loops call the intake methods from their
         # own threads while the tick task reads/swaps the same rings and
@@ -714,6 +719,7 @@ class QuorumEngine:
         # counters stay readable through engine.metrics (tests inspect a
         # closed cluster's engines)
         self._m.unregister()
+        self.ledger.unregister()
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()

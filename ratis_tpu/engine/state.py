@@ -52,6 +52,15 @@ class GroupBatchState:
         self.vote_grants = np.zeros((g, p), bool)
         self.vote_rejects = np.zeros((g, p), bool)
         self.vote_deadline_ms = np.full(g, NO_DEADLINE, np.int32)
+        # Lag-ledger inputs (engine/ledger.py): last applied index and
+        # leader pending-queue depth mirrored from the division, the
+        # server-wide dense peer id per [slot, column] (-1 = unmapped),
+        # and a per-slot allocation generation so delta baselines from a
+        # released slot never bleed into its next tenant.
+        self.applied_index = np.full(g, -1, np.int32)
+        self.pending_count = np.zeros(g, np.int32)
+        self.peer_index = np.full((g, p), -1, np.int32)
+        self.alloc_gen = np.zeros(g, np.int32)
         self._free: list[int] = list(range(g - 1, -1, -1))
         self.active: set[int] = set()
         # Slots whose host-side state changed since the last engine tick.
@@ -68,6 +77,7 @@ class GroupBatchState:
             self._grow()
         slot = self._free.pop()
         self.active.add(slot)
+        self.alloc_gen[slot] += 1
         self.mark_dirty(slot)
         return slot
 
@@ -84,6 +94,9 @@ class GroupBatchState:
         self.vote_grants[slot] = False
         self.vote_rejects[slot] = False
         self.vote_deadline_ms[slot] = NO_DEADLINE
+        self.applied_index[slot] = -1
+        self.pending_count[slot] = 0
+        self.peer_index[slot] = -1
         self._free.append(slot)
         self.mark_dirty(slot)
 
@@ -94,22 +107,23 @@ class GroupBatchState:
         new = old * 2
         for name in ("role", "self_slot", "flush_index", "commit_index",
                      "first_leader_index", "election_deadline_ms",
-                     "self_priority", "vote_deadline_ms"):
+                     "self_priority", "vote_deadline_ms", "applied_index",
+                     "pending_count", "alloc_gen"):
             a = getattr(self, name)
             b = np.zeros(new, a.dtype)
             b[:old] = a
-            if name == "flush_index" or name == "commit_index":
+            if name in ("flush_index", "commit_index", "applied_index"):
                 b[old:] = -1
             if name in ("election_deadline_ms", "vote_deadline_ms"):
                 b[old:] = NO_DEADLINE
             setattr(self, name, b)
         for name in ("self_mask", "conf_cur", "conf_old", "priority",
                      "match_index", "next_index", "last_ack_ms",
-                     "vote_grants", "vote_rejects"):
+                     "vote_grants", "vote_rejects", "peer_index"):
             a = getattr(self, name)
             b = np.zeros((new, self.max_peers), a.dtype)
             b[:old] = a
-            if name == "match_index":
+            if name in ("match_index", "peer_index"):
                 b[old:] = -1
             setattr(self, name, b)
         self._free.extend(range(new - 1, old - 1, -1))

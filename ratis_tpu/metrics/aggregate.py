@@ -321,3 +321,26 @@ async def scrape_cluster_timeseries(addresses: list[str],
     if unreachable:
         merged["unreachable"] = unreachable
     return merged
+
+
+async def scrape_cluster_lag(addresses: list[str],
+                             timeout_s: float = 10.0) -> dict:
+    """Scrape ``GET /lag`` from every address: one ledger payload per
+    live server (`shell lag` renders the peers x servers heatmap from
+    them), unreachable endpoints degrade to an ``unreachable`` entry —
+    same contract as :func:`scrape_cluster`."""
+    results = await asyncio.gather(
+        *(fetch_json(a, "/lag", timeout_s) for a in addresses),
+        return_exceptions=True)
+    servers, unreachable = [], []
+    for addr, res in zip(addresses, results):
+        if isinstance(res, BaseException):
+            unreachable.append({"address": addr,
+                                "error": str(res) or type(res).__name__})
+            continue
+        res["address"] = addr
+        servers.append(res)
+    out = {"servers": servers}
+    if unreachable:
+        out["unreachable"] = unreachable
+    return out

@@ -93,8 +93,20 @@ class FlightRecorder:
             "samples": list(self.sampler.samples),
             "events": (watchdog.events() if watchdog is not None else []),
             "hot_groups": self.sampler.hotgroups_info(),
+            "lag_ledger": self._lag_block(),
             "spans": _recent_spans(),
         }
+
+    def _lag_block(self) -> Optional[dict]:
+        """The lag & health ledger at dump time (same payload as GET
+        /lag); None only if the engine is mid-teardown — a flight dump
+        must never fail over its own observability."""
+        try:
+            return self.server.lag_info()
+        except Exception:
+            LOG.exception("%s flight: lag ledger snapshot failed",
+                          self.server.peer_id)
+            return None
 
     def flightrecorder_info(self, query: Optional[dict] = None) -> dict:
         """``GET /flightrecorder[?dump=1]``: the live payload; with

@@ -260,6 +260,7 @@ class Division:
         """Jump the applied frontier (snapshot install/restore)."""
         self._applied_index = max(self._applied_index, index)
         self.applied_waiters.advance(self._applied_index)
+        self._engine_set_applied()
 
     def random_election_timeout_s(self) -> float:
         return self._rng.uniform(self._timeout_min_s, self._timeout_max_s)
@@ -319,6 +320,7 @@ class Division:
         self.engine_slot = engine.attach(self)
         self._assign_peer_slots()
         self._sync_conf_to_engine()
+        self._engine_set_applied()
         engine.state.role[self.engine_slot] = (
             ROLE_LISTENER if self.is_listener() else ROLE_FOLLOWER)
         if not self.is_listener():
@@ -368,6 +370,7 @@ class Division:
                     st.match_index[self.engine_slot, col] = -1
                     st.last_ack_ms[self.engine_slot, col] = 0
                     st.priority[self.engine_slot, col] = 0
+                    st.peer_index[self.engine_slot, col] = -1
                     st.mark_dirty(self.engine_slot)
 
     def _sync_conf_to_engine(self) -> None:
@@ -391,9 +394,28 @@ class Division:
                     prio[s] = p.priority
         me = self.peer_slots[self.member_id.peer_id]
         my_peer = conf.get_peer(self.member_id.peer_id)
-        self.server.engine.state.set_conf(
+        engine = self.server.engine
+        # dense peer ids for the lag ledger's per-peer aggregation
+        pidx = np.full(n, -1, np.int32)
+        for pid, s in self.peer_slots.items():
+            pidx[s] = engine.ledger.peer_for(pid)
+        engine.state.peer_index[self.engine_slot] = pidx
+        engine.state.set_conf(
             self.engine_slot, me, cur, old, prio,
             my_peer.priority if my_peer is not None else 0)
+
+    def _engine_set_applied(self) -> None:
+        """Mirror the applied frontier into the lag ledger's [G] array
+        (batch-level: once per apply sweep, not per entry)."""
+        if self.engine_slot >= 0:
+            self.server.engine.state.applied_index[self.engine_slot] = \
+                self._applied_index
+
+    def _engine_set_pending(self, n: int) -> None:
+        """Mirror the leader pending-queue depth for the ledger/sampler
+        (called by PendingRequests on add/pop/drain)."""
+        if self.engine_slot >= 0:
+            self.server.engine.state.pending_count[self.engine_slot] = n
 
     def reset_election_deadline(self) -> None:
         self._wake_nudge_s = 0.0
@@ -482,6 +504,7 @@ class Division:
             if snap is not None:
                 snapshot_index = snap.index
                 self._applied_index = snap.index
+                self._engine_set_applied()
         else:
             await self.state_machine.initialize(self.server, self.group_id, None)
             snap = None
@@ -2234,6 +2257,7 @@ class Division:
                     batch = []
             if batch:
                 self._flush_reply_batch(batch)
+            self._engine_set_applied()
             self.applied_waiters.advance(self._applied_index)
             log.evict_cache(self._applied_index)
             if self.is_leader() and self.leader_ctx is not None \

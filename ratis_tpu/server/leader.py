@@ -76,11 +76,15 @@ class PendingRequests:
     """index -> in-flight client write, with byte/element permits
     (reference PendingRequests.java:51,100-110)."""
 
-    def __init__(self, element_limit: int = 4096, byte_limit: int = 64 << 20):
+    def __init__(self, element_limit: int = 4096, byte_limit: int = 64 << 20,
+                 mirror=None):
         self._map: dict[int, PendingRequest] = {}
         self._element_limit = element_limit
         self._byte_limit = byte_limit
         self._bytes = 0
+        # depth mirror into the engine's pending_count[G] (lag ledger /
+        # telemetry sampler read it array-wise instead of walking leaders)
+        self._mirror = mirror
 
     def add(self, index: int, request: RaftClientRequest) -> PendingRequest:
         size = request.message.size()
@@ -92,12 +96,16 @@ class PendingRequests:
         p = PendingRequest(index, request)
         self._map[index] = p
         self._bytes += size
+        if self._mirror is not None:
+            self._mirror(len(self._map))
         return p
 
     def pop(self, index: int) -> Optional[PendingRequest]:
         p = self._map.pop(index, None)
         if p is not None:
             self._bytes -= p.request.message.size()
+            if self._mirror is not None:
+                self._mirror(len(self._map))
         return p
 
     def requests(self) -> list[RaftClientRequest]:
@@ -110,6 +118,8 @@ class PendingRequests:
             p.fail(exception)
         self._map.clear()
         self._bytes = 0
+        if self._mirror is not None:
+            self._mirror(0)
         return n
 
     def __len__(self) -> int:
@@ -692,7 +702,8 @@ class LeaderContext:
         p = division.server.properties
         self.pending = PendingRequests(
             RaftServerConfigKeys.Write.element_limit(p),
-            RaftServerConfigKeys.Write.byte_limit(p))
+            RaftServerConfigKeys.Write.byte_limit(p),
+            mirror=division._engine_set_pending)
         self.followers: dict[RaftPeerId, FollowerInfo] = {}
         self.appenders: dict[RaftPeerId, LogAppender] = {}
         self.startup_index: int = -1  # the conf entry appended on election

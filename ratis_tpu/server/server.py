@@ -363,6 +363,12 @@ class RaftServer:
             mesh=mesh,
             profile_dir=RaftServerConfigKeys.Engine.profile_dir(p) or None,
             name=str(peer_id))
+        # lag & health ledger thresholds (raft.tpu.lag.*); the ledger
+        # itself is part of the engine
+        self.engine.ledger.lag_threshold = RaftServerConfigKeys.Lag.threshold(p)
+        self.engine.ledger.up_window_ms = int(
+            RaftServerConfigKeys.Lag.up_window(p).to_ms())
+        self.lag_top_groups = RaftServerConfigKeys.Lag.top_groups(p)
         self.pause_monitor = None  # started in start() when enabled
         # Observability plane (raft.tpu.metrics.http-port /
         # raft.tpu.watchdog.*): the per-server introspection endpoint and
@@ -532,7 +538,8 @@ class RaftServer:
             self.watchdog.start()
         json_routes = {"/health": self.health_info,
                        "/divisions": self.divisions_info,
-                       "/events": self.watchdog_events}
+                       "/events": self.watchdog_events,
+                       "/lag": self.lag_info}
         if _K.Telemetry.enabled(self.properties):
             from ratis_tpu.metrics.flight import (FlightRecorder,
                                                   install_sigterm_dump)
@@ -934,6 +941,77 @@ class RaftServer:
         commit/applied, follower lag, cache sizes, shard placement)."""
         return [div.introspect()
                 for div in list(self.divisions.values())]
+
+    def lag_info(self, query=None) -> dict:
+        """GET /lag: the lag & health ledger — per-peer link/health
+        rollups with log2 lag histograms, plus the top-k laggard groups
+        (``?n=<k>`` overrides raft.tpu.lag.top-groups).  One fused engine
+        pass + one device fetch, O(peers + k) python."""
+        import os
+
+        import numpy as np
+        n = self.lag_top_groups
+        if query:
+            try:
+                n = int(query.get("n", [None])[0])
+            except (TypeError, ValueError):
+                pass
+        ledger = self.engine.ledger
+        s = ledger.sample()
+        peers = []
+        for i, name in enumerate(s.peer_names):
+            links = int(s.peer_links[i])
+            if links == 0:
+                continue
+            up = int(s.peer_up[i])
+            active = int(s.peer_active[i])
+            laggy_active = int(s.peer_laggy_active[i])
+            # health score: healthy share of the links that matter —
+            # 1.0 = every active link inside the lag threshold; down
+            # links count against the score like laggy ones
+            down = links - up
+            bad = laggy_active + down
+            score = round(1.0 - bad / max(1, active + down), 4)
+            hist = {int(b): int(c)
+                    for b, c in enumerate(s.hist[i]) if c}
+            peers.append({
+                "peer": name, "links": links, "up": up, "down": down,
+                "laggy": int(s.peer_laggy[i]), "active": active,
+                "laggyActive": laggy_active,
+                "maxLag": max(0, int(s.peer_max_lag[i])),
+                "score": score, "hist": hist,
+            })
+        groups = []
+        order = np.argsort(-s.worst_lag, kind="stable")
+        for slot in order[:max(0, n)]:
+            lag = int(s.worst_lag[slot])
+            if lag <= 0:
+                break  # sorted: nothing laggy past here
+            listener = self.engine._listeners.get(int(slot))
+            if listener is None:
+                continue
+            gid = listener.group_id
+            peer_idx = int(s.worst_peer[slot])
+            groups.append({
+                "group": str(gid), "lag": lag,
+                "peer": (s.peer_names[peer_idx]
+                         if 0 <= peer_idx < len(s.peer_names) else "?"),
+                "commit": int(s.commit[slot]),
+                "gap": int(s.gap[slot]),
+                "shard": self.shard_of_group(gid),
+            })
+        return {
+            "peer": str(self.peer_id),
+            "pid": os.getpid(),
+            "now_ms": s.now_ms,
+            "lagThreshold": ledger.lag_threshold,
+            "upWindowMs": ledger.up_window_ms,
+            "leading": s.leading,
+            "gapTotal": s.gap_total,
+            "fetchMs": s.fetch_ms,
+            "peers": peers,
+            "groups": groups,
+        }
 
     def watchdog_events(self, query=None) -> dict:
         """GET /events: the stall watchdog's bounded event journal.

@@ -61,9 +61,19 @@ KIND_FAULT_RECOVERED = "fault-recovered"
 # the configured rate for a whole interval — bounded pending is working
 # as designed, but the operator should know the fleet is over capacity.
 KIND_OVERLOAD = "overload"
+# Grey follower (lag-ledger detector): one peer slow-but-alive across a
+# threshold fraction of the groups it follows — every link up (acking
+# within the up-window) yet lagging on most advancing groups at once.
+# Neither commit-stall (quorum still commits) nor election-churn (the
+# peer never times out) catches this shape; it is the signature partial
+# failure of a fleet-wide slow disk/NIC.  Episodes pair grey-follower
+# with grey-recovered through the same fault-correlation id the chaos
+# campaign uses for injected faults.
+KIND_GREY_FOLLOWER = "grey-follower"
+KIND_GREY_RECOVERED = "grey-recovered"
 KINDS = (KIND_COMMIT_STALL, KIND_ELECTION_CHURN, KIND_FOLLOWER_LAG,
          KIND_STUCK_LANE, KIND_INJECTED_FAULT, KIND_FAULT_RECOVERED,
-         KIND_OVERLOAD)
+         KIND_OVERLOAD, KIND_GREY_FOLLOWER, KIND_GREY_RECOVERED)
 
 # consecutive flat samples (with pending requests) before a commit-stall
 # event is journaled: one flat interval is ordinary queueing, two is not
@@ -121,6 +131,16 @@ class StallWatchdog:
             RaftServerConfigKeys.Serving.overload_shed_rate(p)
         self._last_shed = None
         self._overloaded = False
+        # grey-follower detection over the lag ledger (raft.tpu.lag.grey.*;
+        # mutable attributes so tests/chaos retune live, like lag_threshold)
+        lag_keys = RaftServerConfigKeys.Lag
+        self.grey_fraction = lag_keys.grey_fraction(p)
+        self.grey_min_groups = lag_keys.grey_min_groups(p)
+        self.grey_rounds = lag_keys.grey_rounds(p)
+        self._grey_seen: dict = {}   # peer name -> consecutive grey rounds
+        self._grey: set = set()      # peers inside a reported grey episode
+        self._grey_fault: dict = {}  # peer name -> episode correlation id
+        self._grey_seq = 0
         info = MetricRegistryInfo(prefix=str(server.peer_id),
                                   application="ratis", component="server",
                                   name="watchdog")
@@ -237,28 +257,17 @@ class StallWatchdog:
                           f"commitIndex flat at {commit} for "
                           f"{rounds * self.interval_s:.1f}s with "
                           f"{pending} pending request(s)")
-            # follower lag (leader view): one event per lag episode
-            worst = None
-            for pid, f in list(div.leader_ctx.followers.items()):
-                lag = commit - int(f.match_index)
-                if lag > self.lag_threshold and (
-                        worst is None or lag > worst[1]):
-                    worst = (pid, lag)
-            if worst is not None:
-                if gid not in self._lagging:
-                    self._lagging.add(gid)
-                    self.emit(KIND_FOLLOWER_LAG, gid,
-                              f"follower {worst[0]} is {worst[1]} entries "
-                              f"behind commit {commit} "
-                              f"(threshold {self.lag_threshold})")
-            else:
-                self._lagging.discard(gid)
         # drop bookkeeping for removed groups
         for gid in list(self._stall):
             if gid not in seen:
                 self._stall.pop(gid, None)
         self._stalled &= seen
-        self._lagging &= seen
+        # follower lag + grey detection read the lag ledger (one fused
+        # pass + one fetch) instead of walking leader_ctx.followers
+        led = self._ledger_sample()
+        if led is not None:
+            self._check_follower_lag(led)
+            self._check_grey(led)
         # election churn: rate of new election activity per interval
         if self._last_elections is not None:
             delta = elections - self._last_elections
@@ -270,6 +279,86 @@ class StallWatchdog:
         self._last_elections = elections
         self._check_stuck_lanes()
         self._check_overload()
+
+    def _ledger_sample(self):
+        """One lag-ledger pass (engine/ledger.py); None if the engine is
+        mid-teardown — detection must degrade, never throw."""
+        try:
+            return self.server.engine.ledger.sample()
+        except Exception:
+            LOG.exception("%s watchdog: ledger sample failed",
+                          self.server.peer_id)
+            return None
+
+    def _check_follower_lag(self, s) -> None:
+        """Follower lag from the ledger's per-group worst-link vector:
+        python touches only the slots past threshold.  Same kind, same
+        detail shape, same one-event-per-episode latch as the old
+        division walk, so shell health and flight pairing are unchanged."""
+        import numpy as np
+        engine = self.server.engine
+        current: set = set()
+        for slot in np.nonzero(s.worst_lag > self.lag_threshold)[0]:
+            listener = engine._listeners.get(int(slot))
+            if listener is None:
+                continue  # detached mid-pass
+            gid = str(listener.group_id)
+            current.add(gid)
+            if gid in self._lagging:
+                continue
+            self._lagging.add(gid)
+            peer_idx = int(s.worst_peer[slot])
+            peer = (s.peer_names[peer_idx]
+                    if 0 <= peer_idx < len(s.peer_names) else "?")
+            self.emit(KIND_FOLLOWER_LAG, gid,
+                      f"follower {peer} is {int(s.worst_lag[slot])} "
+                      f"entries behind commit {int(s.commit[slot])} "
+                      f"(threshold {self.lag_threshold})")
+        self._lagging &= current
+
+    def _check_grey(self, s) -> None:
+        """Grey-follower episodes from the ledger's per-peer link counts:
+        a peer whose links are ALL up (acking inside the up-window) while
+        >= grey_fraction of its active links (up links of groups whose
+        commit advanced this pass, at least grey_min_groups of them) sit
+        past the lag threshold, sustained grey_rounds consecutive
+        samples.  One grey-follower event per episode, paired with a
+        grey-recovered event through a fault correlation id on close."""
+        grey_now: set = set()
+        for i, name in enumerate(s.peer_names):
+            links = int(s.peer_links[i])
+            if links == 0:
+                continue  # self, or a peer this server leads no groups to
+            down = links - int(s.peer_up[i])
+            active = int(s.peer_active[i])
+            laggy = int(s.peer_laggy_active[i])
+            if (down == 0 and active >= self.grey_min_groups
+                    and laggy / max(1, active) >= self.grey_fraction):
+                grey_now.add(name)
+                rounds = self._grey_seen.get(name, 0) + 1
+                self._grey_seen[name] = rounds
+                if rounds >= self.grey_rounds and name not in self._grey:
+                    self._grey.add(name)
+                    fault = f"grey-{name}-{self._grey_seq}"
+                    self._grey_seq += 1
+                    self._grey_fault[name] = fault
+                    self.emit(
+                        KIND_GREY_FOLLOWER, None,
+                        f"peer {name} grey: {laggy}/{active} active "
+                        f"links >= {self.server.engine.ledger.lag_threshold} "
+                        f"entries behind while all {links} links are up "
+                        f"(fraction {laggy / max(1, active):.2f} >= "
+                        f"{self.grey_fraction:g}, max lag "
+                        f"{int(s.peer_max_lag[i])})", fault=fault)
+        for name in list(self._grey_seen):
+            if name not in grey_now:
+                self._grey_seen.pop(name, None)
+        for name in list(self._grey):
+            if name not in grey_now:
+                self._grey.discard(name)
+                self.emit(KIND_GREY_RECOVERED, None,
+                          f"peer {name} recovered: grey episode over",
+                          fault=self._grey_fault.pop(name, None))
 
     def _check_overload(self) -> None:
         """Sustained overload: the admission controller's shed rate over

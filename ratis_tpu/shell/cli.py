@@ -332,7 +332,7 @@ async def cmd_top(args) -> int:
                              merged.get("rates", {}).items())))
         print(f"{'PEER':<10} {'PID':<8} {'C/S':>9} {'ACK/S':>9} "
               f"{'REW/S':>7} {'SHED/S':>7} {'OCC':>6} {'PEND':>6} "
-              f"{'DIV':>6} {'EVT':>5}")
+              f"{'LAG':>6} {'DIV':>6} {'EVT':>5}")
         for pid, proc in sorted(procs.items()):
             addr = merged.get("addresses", {}).get(pid)
             if addr is not None and proc.get("seq", -1) >= 0:
@@ -359,6 +359,7 @@ async def cmd_top(args) -> int:
                   f"{rates.get('shed_per_s', 0):>7g} "
                   f"{last.get('occupancy', 0):>6g} "
                   f"{last.get('pending', 0):>6g} "
+                  f"{last.get('lag', 0):>6g} "
                   f"{last.get('divisions', 0):>6g} "
                   f"{totals.get('events', 0):>5g}")
         hot = (merged.get("hotgroups") or {}).get("groups", [])
@@ -372,6 +373,53 @@ async def cmd_top(args) -> int:
         if args.iterations and i >= args.iterations:
             return 0
         await asyncio.sleep(args.interval)
+
+
+async def cmd_lag(args) -> int:
+    """Cluster lag heatmap over the lag & health ledger: scrape every
+    server's ``GET /lag`` and render the peers x leaders health-score
+    matrix (each row is one server's leader-side view of every follower
+    peer; 1.00 = every watched link inside the lag threshold), then each
+    server's worst laggard groups with their shard placement."""
+    import time as _time
+
+    from ratis_tpu.metrics.aggregate import scrape_cluster_lag
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints:
+        raise SystemExit("pass -endpoints host:port[,host:port...]")
+    out = await scrape_cluster_lag(endpoints, timeout_s=args.timeout)
+    servers = out.get("servers", [])
+    peer_cols = sorted({p["peer"] for s in servers for p in s["peers"]})
+    thr = servers[0]["lagThreshold"] if servers else "?"
+    print(f"-- lag @ {_time.strftime('%H:%M:%S')} | {len(servers)} "
+          f"server(s) | score = healthy share of watched links "
+          f"(threshold {thr} entries; '-' = no links)")
+    print(f"{'LEADER':<10} {'LEADS':>6} {'GAP':>6} "
+          + " ".join(f"{c:>10}" for c in peer_cols))
+    worst_lines = []
+    for s in servers:
+        by = {p["peer"]: p for p in s["peers"]}
+        cells = []
+        for name in peer_cols:
+            p = by.get(name)
+            cells.append("-" if p is None else f"{p['score']:.2f}")
+        print(f"{str(s.get('peer') or '?'):<10} {s['leading']:>6} "
+              f"{s['gapTotal']:>6} "
+              + " ".join(f"{c:>10}" for c in cells))
+        if s.get("groups"):
+            worst_lines.append(
+                f"  {s['peer']} worst: " + "  ".join(
+                    f"{g['group']}[shard{g['shard']}]={g['lag']}"
+                    f" via {g['peer']}" for g in s["groups"]))
+    if worst_lines:
+        print("laggard groups (entries behind commit):")
+        for line in worst_lines:
+            print(line)
+    rc = 0
+    for dead in out.get("unreachable", []):
+        rc = 1
+        print(f"  UNREACHABLE {dead['address']}: {dead['error']}")
+    return rc
 
 
 def cmd_local_raft_meta_conf(args) -> int:
@@ -492,6 +540,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="refresh count (0 = until interrupted)")
     p.add_argument("-timeout", type=float, default=10.0, help="seconds")
     p.set_defaults(func=cmd_top)
+
+    p = sub.add_parser(
+        "lag",
+        help="cluster lag heatmap over the lag & health ledger "
+             "(every server's GET /lag: per-peer health scores + "
+             "worst laggard groups)")
+    p.add_argument("-endpoints", required=True,
+                   help="comma list of host:port metrics endpoints")
+    p.add_argument("-timeout", type=float, default=10.0, help="seconds")
+    p.set_defaults(func=cmd_lag)
 
     lo = sub.add_parser("local").add_subparsers(dest="sub", required=True)
     p = lo.add_parser("raftMetaConf")

@@ -1235,6 +1235,40 @@ async def _started_cluster(num_groups: int, batched: bool,
             await cluster.close()
 
 
+def _blocking_best_of_3(fn) -> float:
+    """Best-of-3 loop-blocking seconds for one sampling pass: thread CPU
+    time, not wall — the device ledger pass runs on XLA's intra-op pool
+    with the GIL released, so its wall time is not time stolen from the
+    serving event loop, while the pure-python walk holds the GIL for its
+    entire wall time.  Thread CPU is the cost a loop-resident sampler
+    actually charges the cluster (and what the round-11 ≤2% overhead
+    bound is made of)."""
+    best = None
+    for _ in range(3):
+        t0 = time.thread_time()
+        fn()
+        dt = time.thread_time() - t0
+        best = dt if best is None else min(best, dt)
+    return best or 0.0
+
+
+def _pass_cost_pair_ms(cluster, tel) -> tuple:
+    """The round-14 before/after, measured back-to-back on the same live
+    cluster state: (forced ledger-fed sampler pass, retired PR 8
+    per-division python walk), both as best-of-3 loop-blocking ms, worst
+    server of each.  The walk gets a fresh anchor dict per call (its
+    steady-state get+set cost is the same python loop)."""
+    from ratis_tpu.metrics.timeseries import legacy_division_walk
+    pass_worst = walk_worst = 0.0
+    for s2, t in zip(
+            [s2 for s2 in cluster.servers if s2.telemetry is not None],
+            tel):
+        pass_worst = max(pass_worst, _blocking_best_of_3(t.sample))
+        walk_worst = max(walk_worst, _blocking_best_of_3(
+            lambda: legacy_division_walk(s2, {})))
+    return round(pass_worst * 1e3, 3), round(walk_worst * 1e3, 3)
+
+
 async def run_bench(num_groups: int, writes_per_group: int,
                     batched: bool = True, concurrency: int = 256,
                     warmup_writes: int = 1, transport: str = "sim",
@@ -1367,15 +1401,29 @@ async def run_bench(num_groups: int, writes_per_group: int,
             from ratis_tpu.metrics.aggregate import merge_hotgroups
             hot = merge_hotgroups([t.hotgroups_info() for t in tel], n=4)
             top = hot["groups"][0] if hot["groups"] else None
+            # the run's cost percentiles BEFORE the forced round-14
+            # passes below append their own samples to the reservoir
+            sample_cost_p99_ms = round(max(
+                t._sample_cost.percentile_s(0.99) for t in tel) * 1e3, 3)
+            sampler_pass_ms, walk_pass_ms = _pass_cost_pair_ms(
+                cluster, tel)
             result["telemetry"] = {
                 "samples": sum(t._samples_taken.count for t in tel),
-                "sample_cost_p99_ms": round(max(
-                    t._sample_cost.percentile_s(0.99) for t in tel)
-                    * 1e3, 3),
+                "sample_cost_p99_ms": sample_cost_p99_ms,
                 # guaranteed share of the hottest group: ~0 under
                 # uniform load, the true share under genuine skew
                 "hot_share": top["share_min"] if top else 0.0,
                 "hot_group": top["group"] if top else None,
+                # round-14 headline: loop-blocking ms of the ledger-fed
+                # sampler pass vs the retired per-division python walk,
+                # back-to-back on the same live state, plus the device
+                # ledger fetch (wall p50 over the run)
+                "sampler_pass_ms": sampler_pass_ms,
+                "walk_pass_ms": walk_pass_ms,
+                "ledger_fetch_ms": round(max(
+                    (s2.engine.ledger.fetch_timer.percentile_s(0.5)
+                     for s2 in cluster.servers
+                     if s2.telemetry is not None), default=0.0) * 1e3, 3),
             }
         result["groups"] = num_groups
         result["mode"] = "batched" if batched else "scalar"

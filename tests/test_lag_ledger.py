@@ -1,0 +1,593 @@
+"""Lag & health ledger: the fused device pass (ops/ledger.py) against a
+naive Python reference, the engine-attached LagLedger's delta/generation
+semantics, the ledger-fed sampler's bit-identical hot-group sketch, the
+flat pass-cost scaling that retired the per-division walk, GET /lag +
+the flight-recorder ledger block, the grey-follower detector, `shell
+lag` across two real processes, and the grey_follower chaos scenario."""
+
+import asyncio
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from minicluster import MiniCluster, fast_properties
+from ratis_tpu.engine.engine import QuorumEngine
+from ratis_tpu.engine.roles import (ROLE_FOLLOWER, ROLE_LEADER,
+                                    ROLE_UNUSED)
+from ratis_tpu.ops.ledger import (LAG_BUCKETS, lag_buckets, ledger_pass,
+                                  pack_slices, packed_size)
+
+
+class _Listener:
+    def __init__(self, gid):
+        self.group_id = gid
+
+
+def _lag_properties(telemetry: bool = False):
+    p = fast_properties()
+    p.set("raft.tpu.metrics.http-port", "0")
+    # slow background cadences: tests below force samples by hand and
+    # must own the ledger's delta window
+    p.set("raft.tpu.watchdog.interval", "10s")
+    if telemetry:
+        p.set("raft.tpu.telemetry.enabled", "true")
+        p.set("raft.tpu.telemetry.interval", "100ms")
+    return p
+
+
+# ------------------------------------------------------------ unit layer
+
+def _reference_pass(role, match, commit, applied, cur, old, selfm, ack,
+                    pidx, prev_commit, prev_valid, now, threshold,
+                    up_window, num_peers):
+    """Naive per-(group, peer) Python loops over the same inputs — the
+    semantics ops.ledger_pass must vectorize exactly."""
+    g, p = match.shape
+    gap = np.zeros(g, np.int64)
+    delta = np.zeros(g, np.int64)
+    worst_lag = np.full(g, -1, np.int64)
+    worst_peer = np.full(g, -1, np.int64)
+    hist = np.zeros((num_peers, LAG_BUCKETS), np.int64)
+    links = np.zeros(num_peers, np.int64)
+    up_c = np.zeros(num_peers, np.int64)
+    laggy_c = np.zeros(num_peers, np.int64)
+    active_c = np.zeros(num_peers, np.int64)
+    laggy_active_c = np.zeros(num_peers, np.int64)
+    peer_max = np.full(num_peers, -1, np.int64)
+    leading = 0
+    for i in range(g):
+        is_leader = role[i] == ROLE_LEADER
+        if is_leader:
+            leading += 1
+        if role[i] != ROLE_UNUSED:
+            gap[i] = max(0, int(commit[i]) - int(applied[i]))
+        if is_leader and prev_valid[i]:
+            delta[i] = max(0, int(commit[i]) - int(prev_commit[i]))
+        for j in range(p):
+            valid = ((cur[i, j] or old[i, j]) and not selfm[i, j]
+                     and is_leader and pidx[i, j] >= 0)
+            if not valid:
+                continue
+            lag = max(0, int(commit[i]) - int(match[i, j]))
+            # first-maximum tie-break, same as argmax in the kernel
+            if lag > worst_lag[i]:
+                worst_lag[i] = lag
+                worst_peer[i] = pidx[i, j]
+            w = int(pidx[i, j])
+            hist[w, int(lag).bit_length()] += 1
+            links[w] += 1
+            up = (now - int(ack[i, j])) <= up_window
+            laggy = lag >= threshold
+            link_active = up and delta[i] > 0
+            up_c[w] += up
+            laggy_c[w] += laggy
+            active_c[w] += link_active
+            laggy_active_c[w] += link_active and laggy
+            peer_max[w] = max(peer_max[w], lag)
+    return {"gap": gap, "delta": delta, "worst_lag": worst_lag,
+            "worst_peer": worst_peer, "hist": hist.ravel(),
+            "peer_links": links, "peer_up": up_c, "peer_laggy": laggy_c,
+            "peer_active": active_c, "peer_laggy_active": laggy_active_c,
+            "peer_max_lag": peer_max,
+            "scalars": np.array([leading, gap.sum()], np.int64)}
+
+
+def test_ledger_pass_matches_python_reference():
+    """Randomized scalar-vs-vectorized equivalence: every packed section
+    of the fused pass equals the naive loop, including unused rows, old
+    conf members, unmapped peer columns, and duplicate peer ids."""
+    g, p, w = 24, 5, 8
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        role = rng.choice([ROLE_UNUSED, ROLE_FOLLOWER, ROLE_LEADER],
+                          g).astype(np.int8)
+        commit = rng.integers(-1, 200, g).astype(np.int32)
+        match = rng.integers(-1, 200, (g, p)).astype(np.int32)
+        applied = rng.integers(-1, 200, g).astype(np.int32)
+        cur = rng.random((g, p)) < 0.7
+        old = rng.random((g, p)) < 0.2
+        selfm = np.zeros((g, p), bool)
+        selfm[np.arange(g), rng.integers(0, p, g)] = True
+        ack = rng.integers(0, 6000, (g, p)).astype(np.int32)
+        pidx = rng.integers(-1, w, (g, p)).astype(np.int32)
+        prev_commit = rng.integers(-1, 200, g).astype(np.int32)
+        prev_valid = rng.random(g) < 0.6
+        now, threshold, up_window = 5000, 4, 3000
+        packed = np.asarray(ledger_pass(
+            role, match, commit, applied, cur, old, selfm, ack, pidx,
+            prev_commit, prev_valid, np.int32(now), np.int32(threshold),
+            np.int32(up_window), num_peers=w))
+        assert packed.shape == (packed_size(g, w),)
+        ref = _reference_pass(role, match, commit, applied, cur, old,
+                              selfm, ack, pidx, prev_commit, prev_valid,
+                              now, threshold, up_window, w)
+        sl = pack_slices(g, w)
+        for name, want in ref.items():
+            got = packed[sl[name]]
+            assert (got == want).all(), \
+                f"[seed {seed}] section {name}: {got} != {want}"
+
+
+def test_lag_histogram_bucket_units():
+    """Bucket 0 = caught up; bucket i >= 1 = lag in [2^(i-1), 2^i) —
+    exact at the power-of-two boundaries (a float log would misfile)."""
+    lags = np.array([0, 1, 2, 3, 4], np.int32)
+    assert lag_buckets(lags).tolist() == [0, 1, 2, 2, 3]
+    for k in range(1, 30):
+        edge = np.array([(1 << k) - 1, 1 << k], np.int32)
+        assert lag_buckets(edge).tolist() == [k, k + 1]
+    # any int32 lag stays inside the table
+    assert int(lag_buckets(np.int32(2**31 - 1))) == LAG_BUCKETS - 1
+
+
+def _leader_engine(num_groups: int, peers=("s1", "s2")) -> QuorumEngine:
+    """An engine with every slot a 3-member leader wired into the dense
+    peer table, commits at 0 — the shape the live server produces."""
+    e = QuorumEngine(max_groups=num_groups, max_peers=8,
+                     scalar_fallback_threshold=10**9, use_device=False)
+    s = e.state
+    for i in range(num_groups):
+        slot = e.attach(_Listener(f"g{i:04d}"))
+        cur = np.zeros(8, bool)
+        cur[:len(peers) + 1] = True
+        s.set_conf(slot, 0, cur, np.zeros(8, bool),
+                   np.zeros(8, np.int32), 0)
+        s.role[slot] = ROLE_LEADER
+        s.commit_index[slot] = 0
+        s.match_index[slot, :len(peers) + 1] = 0
+        s.applied_index[slot] = 0
+        s.last_ack_ms[slot, :len(peers) + 1] = e.clock.now_ms()
+        pidx = np.full(8, -1, np.int32)
+        for j, peer in enumerate(peers):
+            pidx[j + 1] = e.ledger.peer_for(peer)
+        s.peer_index[slot] = pidx
+    return e
+
+
+def test_ledger_sample_delta_and_generation_semantics():
+    """Engine-level LagLedger: per-group worst lag / gap, the pending
+    mirror, commit deltas anchored at first sight, and the allocation-
+    generation guard that keeps a reused slot from inheriting the old
+    tenant's baseline."""
+    e = _leader_engine(4)
+    st = e.state
+    st.commit_index[0] = 10
+    st.match_index[0, 1] = 3           # s1 is 7 behind on slot 0
+    st.match_index[0, 2] = 8           # s2 only 2 behind
+    st.applied_index[0] = 6            # apply backlog of 4
+    st.pending_count[0] = 5
+    s1 = e.ledger.sample()
+    assert s1.leading == 4
+    assert int(s1.worst_lag[0]) == 7
+    assert s1.peer_names[int(s1.worst_peer[0])] == "s1"
+    assert int(s1.gap[0]) == 4 and s1.gap_total == 4
+    assert int(s1.pending[0]) == 5
+    # first sight anchors: commits existed before the pass, delta 0
+    assert (s1.delta == 0).all()
+    assert s1.fetch_ms >= 0.0 and e.ledger.samples.count == 1
+
+    st.commit_index[0] = 25
+    st.commit_index[1] = 2
+    s2 = e.ledger.sample()
+    assert int(s2.delta[0]) == 15 and int(s2.delta[1]) == 2
+    assert (s2.delta[2:] == 0).all()
+
+    # slot reuse: release + re-attach bumps alloc_gen, so the new
+    # tenant's first pass anchors instead of reading the old baseline
+    e.detach(0)
+    slot = e.attach(_Listener("tenant2"))
+    assert slot == 0
+    st.role[0] = ROLE_LEADER
+    st.commit_index[0] = 1000
+    s3 = e.ledger.sample()
+    assert int(s3.delta[0]) == 0
+    s4 = e.ledger.sample()
+    assert int(s4.delta[0]) == 0      # still flat, no phantom delta
+    # a demoted slot drops its baseline: leader again -> anchor again
+    st.role[1] = ROLE_FOLLOWER
+    e.ledger.sample()
+    st.role[1] = ROLE_LEADER
+    st.commit_index[1] += 50
+    assert int(e.ledger.sample().delta[1]) == 0
+
+
+def test_sampler_sketch_bit_identical_to_legacy_walk():
+    """The ledger-fed TelemetrySampler must feed the Metwally sketch the
+    EXACT offers the retired per-division walk produced — same keys,
+    counts, error bounds, and pending aux — across anchoring, deltas,
+    pending-only groups, leadership flips, and division teardown."""
+    import types
+
+    from ratis_tpu.conf.properties import RaftProperties
+    from ratis_tpu.metrics.registry import MetricRegistries
+    from ratis_tpu.metrics.timeseries import (SpaceSavingSketch,
+                                              TelemetrySampler,
+                                              legacy_division_walk)
+
+    e = _leader_engine(6)
+    st = e.state
+    gids = [e._listeners[i].group_id for i in range(6)]
+
+    class _Log:
+        def __init__(self, slot):
+            self.slot = slot
+
+        def get_last_committed_index(self):
+            return st.commit_index[self.slot]
+
+    def _div(slot, gid):
+        d = types.SimpleNamespace(
+            group_id=gid,
+            state=types.SimpleNamespace(log=_Log(slot)),
+            leader_ctx=types.SimpleNamespace(pending={}))
+        d.is_leader = lambda slot=slot: st.role[slot] == ROLE_LEADER
+        return d
+
+    srv = types.SimpleNamespace(
+        peer_id="lagledger-sketch-test", properties=RaftProperties(),
+        engine=e, watchdog=None,
+        replication=types.SimpleNamespace(metrics={}),
+        divisions={gid: _div(i, gid) for i, gid in enumerate(gids)})
+    sampler = TelemetrySampler(srv, interval_s=1.0, window_s=10.0,
+                               top_k=8)
+    ref_sketch = SpaceSavingSketch(8)
+    last_commit: dict = {}
+
+    def _set_pending(slot, n):
+        st.pending_count[slot] = n
+        srv.divisions[gids[slot]].leader_ctx.pending = {
+            i: None for i in range(n)}
+
+    def _both_pass():
+        legacy_division_walk(srv, last_commit, ref_sketch)
+        sampler.sample()
+        assert sampler.sketch.total == ref_sketch.total
+        assert sampler.sketch._entries == ref_sketch._entries
+
+    _set_pending(2, 3)                # pending-only group rides along
+    _both_pass()                      # pass 1: everyone anchors
+    st.commit_index[0] += 7
+    st.commit_index[1] += 2
+    _both_pass()                      # pass 2: real deltas
+    st.role[1] = ROLE_FOLLOWER       # deposed: both paths drop it
+    st.commit_index[0] += 1
+    _both_pass()
+    st.role[1] = ROLE_LEADER         # re-elected: both re-anchor
+    st.commit_index[1] += 100
+    _both_pass()
+    st.commit_index[1] += 4          # post-anchor delta attributes again
+    _set_pending(2, 0)
+    _both_pass()
+    # division teardown: gone from both views, then a new tenant anchors
+    del srv.divisions[gids[5]]
+    e.detach(5)
+    _both_pass()
+    slot = e.attach(_Listener("fresh"))
+    st.role[slot] = ROLE_LEADER
+    st.commit_index[slot] = 500
+    srv.divisions["fresh"] = _div(slot, "fresh")
+    _both_pass()
+    MetricRegistries.global_registries().remove(sampler._info)
+
+
+# --------------------------------------------------------- pass cost
+
+def test_ledger_pass_cost_flat_in_group_count():
+    """The pass-cost drop that retired the per-division walk: growing
+    the fleet 16x (64 -> 1024 groups) multiplies the walk's Python cost
+    ~linearly while the fused-pass sample stays near-flat — O(1) Python
+    plus one device dispatch whose cost the group axis barely moves."""
+    from ratis_tpu.metrics.timeseries import legacy_division_walk
+
+    def _fake_server(e, n):
+        import types
+        st = e.state
+
+        class _Log:
+            def __init__(self, slot):
+                self.slot = slot
+
+            def get_last_committed_index(self):
+                return st.commit_index[self.slot]
+
+        srv = types.SimpleNamespace()
+        srv.divisions = {}
+        for i in range(n):
+            gid = e._listeners[i].group_id
+            d = types.SimpleNamespace(
+                group_id=gid,
+                state=types.SimpleNamespace(log=_Log(i)),
+                leader_ctx=types.SimpleNamespace(pending={}))
+            d.is_leader = lambda: True
+            srv.divisions[gid] = d
+        return srv
+
+    def _best(f, n=10):
+        best = None
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    costs = {}
+    for n in (64, 1024):
+        e = _leader_engine(n)
+        for _ in range(3):
+            e.ledger.sample()       # warm the jit cache
+        srv = _fake_server(e, n)
+        last: dict = {}
+        legacy_division_walk(srv, last)
+        costs[n] = (_best(e.ledger.sample),
+                    _best(lambda: legacy_division_walk(srv, last)))
+    sample_ratio = costs[1024][0] / max(1e-9, costs[64][0])
+    walk_ratio = costs[1024][1] / max(1e-9, costs[64][1])
+    # 16x more groups: the walk pays ~16x (allow noise down to 6x), the
+    # ledger-fed sample must stay well under half the walk's growth
+    assert walk_ratio > 6.0, (costs, walk_ratio)
+    assert sample_ratio < walk_ratio / 2, (costs, sample_ratio,
+                                           walk_ratio)
+    assert costs[1024][0] < 0.020, f"1024-group sample too slow: {costs}"
+
+
+# ------------------------------------------------- live-cluster endpoints
+
+def test_lag_endpoint_and_flight_recorder_block():
+    """GET /lag serves the ledger (peer health scores, laggard groups),
+    scrape_cluster_lag degrades per-server, and the flight recorder's
+    snapshot embeds the same ledger block."""
+
+    async def body():
+        from ratis_tpu.metrics.aggregate import (fetch_json,
+                                                 scrape_cluster_lag)
+        cluster = MiniCluster(3, properties=_lag_properties(telemetry=True))
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            for _ in range(5):
+                assert (await cluster.send_write()).success
+            srv = cluster.servers[leader.member_id.peer_id]
+            payload = await fetch_json(srv.metrics_http.address, "/lag")
+            for key in ("peer", "pid", "now_ms", "lagThreshold",
+                        "upWindowMs", "leading", "gapTotal", "fetchMs",
+                        "peers", "groups"):
+                assert key in payload, payload
+            assert payload["leading"] >= 1
+            assert payload["lagThreshold"] >= 1
+            peers = {p["peer"]: p for p in payload["peers"]}
+            assert len(peers) == 2         # both followers watched
+            for p in peers.values():
+                assert p["links"] >= 1
+                assert 0.0 <= p["score"] <= 1.0
+                assert sum(p["hist"].values()) == p["links"]
+            # caught-up cluster: laggard list is empty or small-lag only
+            for g in payload["groups"]:
+                assert g["lag"] > 0 and "shard" in g
+
+            out = await scrape_cluster_lag(
+                [s.metrics_http.address
+                 for s in cluster.servers.values()])
+            assert len(out["servers"]) == 3
+            assert not out.get("unreachable")
+            dead = await scrape_cluster_lag(
+                [srv.metrics_http.address, "127.0.0.1:1"], timeout_s=2.0)
+            assert len(dead["servers"]) == 1
+            assert dead["unreachable"][0]["address"] == "127.0.0.1:1"
+
+            # ?n= caps the laggard list
+            info = srv.lag_info(query={"n": ["1"]})
+            assert len(info["groups"]) <= 1
+
+            fr = await fetch_json(srv.metrics_http.address,
+                                  "/flightrecorder")
+            assert fr["lag_ledger"] is not None
+            assert fr["lag_ledger"]["peer"] == str(srv.peer_id)
+            assert "peers" in fr["lag_ledger"]
+        finally:
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+def test_grey_follower_detector_episode():
+    """A follower that keeps acking (inside the up-window) while lagging
+    on every advancing group opens ONE grey episode, and healing closes
+    it with a grey-recovered event carrying the same fault id."""
+    from ratis_tpu.server.watchdog import (KIND_GREY_FOLLOWER,
+                                           KIND_GREY_RECOVERED)
+    from ratis_tpu.util import injection
+
+    async def body():
+        cluster = MiniCluster(3, properties=_lag_properties())
+        await cluster.start()
+        try:
+            leader = await cluster.wait_for_leader()
+            srv = cluster.servers[leader.member_id.peer_id]
+            wd = srv.watchdog
+            # sensitize: 1 entry of lag on 1 active group is grey, and a
+            # 60s up-window keeps the blackholed follower counting as up
+            srv.engine.ledger.lag_threshold = 1
+            srv.engine.ledger.up_window_ms = 60_000
+            wd.grey_fraction = 0.5
+            wd.grey_min_groups = 1
+            wd.grey_rounds = 1
+            followers = [d for d in cluster.divisions()
+                         if d.is_follower()]
+            victim = followers[0].member_id.peer_id
+
+            async def drop(local_id, remote_id, *args):
+                if str(local_id).startswith(str(victim)):
+                    raise RuntimeError("injected: grey follower")
+
+            injection.put(injection.APPEND_ENTRIES, drop)
+            grey = []
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline and not grey:
+                assert (await cluster.send_write()).success
+                wd.sample()
+                grey = [e for e in wd.events()
+                        if e["kind"] == KIND_GREY_FOLLOWER]
+                await asyncio.sleep(0.05)
+            assert grey, wd.events()
+            assert str(victim) in grey[0]["detail"]
+            assert grey[0]["fault"].startswith("grey-")
+
+            injection.clear()
+            recovered = []
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while (asyncio.get_event_loop().time() < deadline
+                   and not recovered):
+                await cluster.send_write()
+                await asyncio.sleep(0.1)
+                wd.sample()
+                recovered = [e for e in wd.events()
+                             if e["kind"] == KIND_GREY_RECOVERED]
+            assert recovered, wd.events()
+            # episode pairing: the recovery carries the SAME fault id
+            assert recovered[0]["fault"] == grey[0]["fault"]
+            # one event per episode, not one per sample
+            assert len([e for e in wd.events()
+                        if e["kind"] == KIND_GREY_FOLLOWER]) == 1
+        finally:
+            injection.clear()
+            await cluster.close()
+
+    asyncio.run(body())
+
+
+# ---------------------------------------------------- shell lag rendering
+
+def _lag_child_script() -> str:
+    """One child process: an in-process trio, a few committed writes,
+    its leader's endpoint printed for the parent to scrape."""
+    return """
+import asyncio, sys
+sys.path.insert(0, %r)
+from minicluster import MiniCluster, fast_properties
+
+async def main():
+    p = fast_properties()
+    p.set("raft.tpu.metrics.http-port", "0")
+    cluster = MiniCluster(3, properties=p)
+    await cluster.start()
+    leader = await cluster.wait_for_leader()
+    for _ in range(5):
+        await cluster.send_write()
+    srv = cluster.servers[leader.member_id.peer_id]
+    print("ENDPOINT " + srv.metrics_http.address, flush=True)
+    while True:
+        await cluster.send_write()
+        await asyncio.sleep(0.02)
+
+asyncio.run(main())
+""" % os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.mark.mp
+def test_shell_lag_renders_matrix_from_two_processes(capsys):
+    """Acceptance: `shell lag` renders the peers x leaders health matrix
+    from >= 2 real processes (each child hosts its own cluster)."""
+    import subprocess
+
+    async def body():
+        import argparse
+        from ratis_tpu.shell.cli import cmd_lag
+        procs = []
+        endpoints = []
+        try:
+            for _ in range(2):
+                proc = subprocess.Popen(
+                    [sys.executable, "-c", _lag_child_script()],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True)
+                procs.append(proc)
+            for proc in procs:
+                line = proc.stdout.readline()
+                assert line.startswith("ENDPOINT "), line
+                endpoints.append(line.split()[1])
+            rc = await cmd_lag(argparse.Namespace(
+                endpoints=",".join(endpoints), timeout=10.0))
+            assert rc == 0
+        finally:
+            for proc in procs:
+                proc.kill()
+        out = capsys.readouterr().out
+        assert "-- lag @" in out and "score = healthy share" in out
+        lines = out.splitlines()
+        header = next(i for i, l in enumerate(lines)
+                      if l.startswith("LEADER"))
+        rows = [l.split() for l in lines[header + 1:]
+                if l and not l.startswith(("laggard", " "))]
+        assert len(rows) == 2          # one matrix row per scraped leader
+        for row in rows:
+            assert int(row[1]) >= 1    # LEADS
+            # every rendered score cell is healthy or absent
+            assert all(c in ("-", "1.00") for c in row[3:]), row
+
+        # an unreachable endpoint degrades to rc=1, never a traceback
+        rc = await cmd_lag(argparse.Namespace(
+            endpoints="127.0.0.1:1", timeout=2.0))
+        assert rc == 1
+        assert "UNREACHABLE" in capsys.readouterr().out
+
+    asyncio.run(body())
+
+
+# ------------------------------------------------------- chaos scenario
+
+@pytest.mark.chaos
+def test_grey_follower_scenario():
+    """The grey_follower chaos scenario: latency+jitter on one follower
+    (never a drop — the link stays up) must raise a grey-follower
+    episode on a live leader, pair it with grey-recovered after the
+    heal, and keep the zero-lost-acks / exactly-once oracles green."""
+    from ratis_tpu.chaos.cluster import ChaosCluster, chaos_properties
+    from ratis_tpu.chaos.scenario import run_scenario
+    from ratis_tpu.chaos.scenarios import build_scenario
+
+    async def main():
+        p = chaos_properties(8, seed=17)
+        cluster = ChaosCluster(3, 8, properties=p, sm="counter", seed=17)
+        await cluster.start()
+        try:
+            cfg = {"servers": 3, "groups": 8, "writers": 4,
+                   "active_groups": 8, "sm": "counter",
+                   "convergence_s": 30.0, "recovery_s": 60.0,
+                   "min_acked": 20}
+            scenario = build_scenario("grey_follower", 17, cfg)
+            result = await run_scenario(cluster, scenario)
+            assert result.passed, (
+                f"[seed 17] grey_follower failed: {result.error}\n"
+                f"journal: {result.journal}")
+            assert result.checks.get("grey_events", 0) >= 1
+            assert (result.checks.get("grey_recovered", 0)
+                    >= result.checks.get("grey_events", 0))
+            assert result.acked > 20
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())

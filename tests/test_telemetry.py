@@ -159,7 +159,7 @@ def test_sampler_survives_division_register_unregister_churn():
             # bookkeeping pruned back to the surviving leaderships
             leaders = sum(1 for d in srv.divisions.values()
                           if d.is_leader())
-            assert len(srv.telemetry._last_commit) <= leaders
+            assert srv.telemetry.tracked_groups <= leaders
         finally:
             await cluster.close()
 

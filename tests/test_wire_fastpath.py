@@ -458,7 +458,10 @@ def test_bench_summary_line_fits_driver_window():
         tel_on=rung(telemetry={"samples": 99999,
                                "sample_cost_p99_ms": 9999.999,
                                "hot_share": 0.9999,
-                               "hot_group": "group-aabbccdd"}),
+                               "hot_group": "group-aabbccdd",
+                               "sampler_pass_ms": 9999.999,
+                               "ledger_fetch_ms": 9999.999,
+                               "walk_pass_ms": 9999.999}),
         tel_off=rung())
     line = json.dumps(summary, separators=(",", ":"))
     assert len(line) < 2000, f"bench line would overflow: {len(line)} chars"
@@ -480,10 +483,12 @@ def test_bench_summary_line_fits_driver_window():
     # observability keys: [engine occupancy, watchdog event count,
     # reply-plane scheduling hops per commit (round-8 fan-out collapse),
     # append-window occupancy (round-9 pipelined windows), the round-11
-    # telemetry-on/off overhead pair, and the headline hot-group skew]
+    # telemetry-on/off overhead pair, the headline hot-group skew, and
+    # the round-14 lag-ledger cost pair [sampler pass p50 ms, device
+    # ledger fetch p50 ms]]
     assert parsed["secondary"]["obs"] == [
         0.9999, 99999 * 6, 99.999, 0.9999,
-        [123457, 123457, 0.0], 0.9999]
+        [123457, 123457, 0.0], 0.9999, [9999.999, 9999.999]]
     assert parsed["secondary"]["win_sweep"]["16"] == [123456.8, 99999.99,
                                                       0.9999]
     # chaos campaign rung: [passed, total, worst reelect s,
