@@ -407,6 +407,51 @@ def child_snapcatch() -> None:
     asyncio.run(main())
 
 
+def child_upkeep(spec: str = "{}") -> None:
+    """Round-15 upkeep-plane rung.  Two measurements in one child:
+    (a) the raw vectorized due-scan at 64 vs 1024 idle registered slots
+    (wall best-of-N — the sublinearity the tier-1 scaling test bounds in
+    thread-CPU), and (b) the live idle-heavy tick pair: a hibernated
+    10240-group fleet's per-sweep cost, plane scan vs the retired
+    per-division walk back-to-back on the same divisions
+    (bench_cluster.run_upkeep_bench)."""
+    cfg = json.loads(spec)
+    _force_cpu_platform()
+    import asyncio
+    import time as _time
+    import types as _types
+
+    from ratis_tpu.server.upkeep import UpkeepPlane
+    from ratis_tpu.tools.bench_cluster import run_upkeep_bench
+
+    def scan_ms(n: int) -> float:
+        plane = UpkeepPlane(server=None, shard=0)
+        for i in range(n):
+            plane.register(_types.SimpleNamespace(idx=i))
+        best = None
+        for _ in range(7):
+            t0 = _time.perf_counter()
+            for _ in range(300):
+                plane.sweep(t0)
+            dt = (_time.perf_counter() - t0) / 300
+            best = dt if best is None else min(best, dt)
+        return round(best * 1e3, 5)
+
+    sweep_64, sweep_1024 = scan_ms(64), scan_ms(1024)
+
+    async def main():
+        out = await run_upkeep_bench(
+            num_groups=cfg.get("groups", 10_240),
+            num_servers=cfg.get("peers", 3),
+            settle_s=cfg.get("settle", 25.0))
+        out["sweep_ms_64"] = sweep_64
+        out["sweep_ms_1024"] = sweep_1024
+        print("RESULT " + json.dumps(out), flush=True)
+        os._exit(0)  # measurement child: skip the 30k-division unwind
+
+    asyncio.run(main())
+
+
 def child_chaos() -> None:
     """chaos_1024 rung (ROADMAP open item 5): the standing chaos
     campaign at the 1024-group batched shape — >= 6 scripted fault
@@ -748,6 +793,30 @@ def main() -> None:
     # typed replies while the served tail stays bounded.
     zipf = _run_child(["--zipf-child"], timeout_s=1800.0,
                       allow_dnf=True)
+    # Round-15 upkeep plane: (a) the 64->1024 sim dip pair with array
+    # mode ON, back-to-back with the (OFF) ladder rungs above — the dip
+    # fraction is THE per-group host-bookkeeping tax made visible; (b)
+    # the idle-heavy hibernated 10240 fleet's tick-cost pair (plane scan
+    # vs the retired per-division walk on the same live divisions).
+    upk_props = {"raft.tpu.upkeep.enabled": "true"}
+    upk_64 = _run_child(["--e2e-child", json.dumps(
+        {"groups": 64, "writes": 128, "batched": True,
+         "concurrency": 128, "transport": "sim", "props": upk_props})],
+        timeout_s=900.0, allow_dnf=True)
+    upk_1024 = _run_child(["--e2e-child", json.dumps(
+        {"groups": 1024, "writes": 8, "batched": True,
+         "concurrency": 128, "transport": "sim", "props": upk_props})],
+        timeout_s=900.0, allow_dnf=True)
+    upk_tick = _run_child(["--upkeep-child", "{}"], timeout_s=1800.0,
+                          allow_dnf=True)
+    upkeep = None
+    if (isinstance(upk_tick, dict) and not upk_tick.get("dnf")
+            and upk_64.get("commits_per_sec")
+            and upk_1024.get("commits_per_sec")):
+        upkeep = [round(upk_tick["sweep_ms_64"], 3),
+                  round(upk_tick["sweep_ms_1024"], 3),
+                  round(1.0 - upk_1024["commits_per_sec"]
+                        / upk_64["commits_per_sec"], 2)]
     # Chaos campaign rung (ROADMAP item 5): correctness-under-stress as
     # a measured artifact at the 1024-group batched shape.
     chaos = _run_child(["--chaos-child"], timeout_s=1800.0,
@@ -776,7 +845,7 @@ def main() -> None:
         kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced,
         filestore5=filestore5, readmix=readmix, snapcatch=snapcatch,
         win_sweep=win_sweep, chaos=chaos, tel_on=tel_on,
-        tel_off=tel_off, zipf=zipf),
+        tel_off=tel_off, zipf=zipf, upkeep=upkeep),
         separators=(",", ":")))
 
 
@@ -898,6 +967,19 @@ def _write_definition() -> None:
         "occupancy]; depth 1 is the latched stop-and-wait-per-group "
         "fallback, so depth-1 vs default attributes the gain to the "
         "pipelined append round trip (docs/replication.md).\n"
+        "- secondary.upkeep: round-15 vectorized upkeep plane "
+        "(raft.tpu.upkeep.enabled; server/upkeep.py packed deadline "
+        "arrays replacing the per-sweep O(G) python walk): [plane sweep "
+        "ms at 64 idle registered slots, at 1024 (the scan is "
+        "overhead-bound, so 16x groups must NOT cost 16x), 64->1024 sim "
+        "dip fraction (1 - cps_1024/cps_64) with array mode ON, "
+        "back-to-back with the mode-OFF sim_ladder rungs].  The "
+        "idle-heavy live pair — a hibernated 10240-group fleet's "
+        "per-sweep tick cost, plane scan vs the retired per-division "
+        "walk measured back-to-back on the same live divisions "
+        "(thread-CPU best-of-3, worst server) — rides in the upkeep "
+        "child's own RESULT record as tick_array_ms / tick_legacy_ms / "
+        "tick_ratio (docs/upkeep.md, docs/perf.md round 15).\n"
         "- secondary.chaos: the round-10 chaos campaign (chaos_1024) at the "
         "1024-group batched shape (durable segmented logs): [scenarios "
         "passed, total, worst re-election convergence s, recovery-"
@@ -955,7 +1037,8 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                mixed, stream, grpc_b, grpc_s_1024, grpc_s_256, kernel,
                kernel_100k, tpu_e2e, traced, filestore5, readmix,
                snapcatch, win_sweep=None, chaos=None, tel_on=None,
-               tel_off=None, mixed_fs=None, zipf=None) -> dict:
+               tel_off=None, mixed_fs=None, zipf=None,
+               upkeep=None) -> dict:
     """Build the one-line JSON summary.  COMPACT by contract: the whole
     line must parse from the driver's 2000-char tail window (r5 lost its
     flagship number to overflow), so keys are short, numbers rounded, and
@@ -1152,6 +1235,12 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                           [snapcatch["catchup_s"], snapcatch["installs"],
                            snapcatch["commits_per_sec"],
                            snapcatch["cps_before"]]),
+            # round-15 upkeep plane: [plane sweep ms at 64 idle slots,
+            # at 1024 idle slots (sublinear scan), 64->1024 sim dip
+            # fraction with array mode ON]; the live hibernated-10240
+            # tick pair (plane vs retired walk, tick_ratio) stays in the
+            # upkeep child's own RESULT record
+            "upkeep": upkeep if upkeep is not None else {"dnf": True},
             # chaos campaign at the 1024-group batched shape: [scenarios
             # passed, total, worst re-election convergence s, recovery-
             # throughput fraction (post-heal rate / pre-fault baseline,
@@ -1175,11 +1264,12 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                 if tpu_e2e.get("dnf") else
                 {"cps": tpu_e2e["commits_per_sec"],
                  "p50": tpu_e2e["p50_ms"]}),
-            "kernel": [kernel["group_updates_per_sec"],
+            "kernel": [round(kernel["group_updates_per_sec"]),
                        kernel["vs_scalar_loop"], kernel["platform"]],
             "kernel_100k": (
                 None if kernel_100k.get("dnf")
-                else kernel_100k.get("group_updates_per_sec_100k")),
+                or kernel_100k.get("group_updates_per_sec_100k") is None
+                else round(kernel_100k["group_updates_per_sec_100k"])),
             "wire_sim": (
                 {"dnf": True} if traced.get("dnf") else {
                     **_compact_decomp(
@@ -1212,6 +1302,8 @@ if __name__ == "__main__":
         child_snapcatch()
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-child":
         child_zipf()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--upkeep-child":
+        child_upkeep(sys.argv[2] if len(sys.argv) > 2 else "{}")
     elif len(sys.argv) > 1 and sys.argv[1] == "--chaos-child":
         child_chaos()
     else:

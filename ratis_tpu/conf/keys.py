@@ -418,6 +418,35 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Hibernate.BACKSTOP_KEY,
                 RaftServerConfigKeys.Hibernate.BACKSTOP_DEFAULT)
 
+    class Upkeep:
+        """Vectorized upkeep plane (server/upkeep.py): per-loop-shard
+        packed deadline arrays replace the per-group Python walk in the
+        heartbeat sweep, hibernation backstop, retry-cache/write-index
+        expiry, client-window sweep, and watch-frontier refresh.  OFF by
+        default; unset reproduces the per-group paths bit-for-bit."""
+
+        ENABLED_KEY = "raft.tpu.upkeep.enabled"
+        ENABLED_DEFAULT = False
+        # Full-walk resync cadence (sweeps): every N sweeps the plane
+        # re-derives every registered division's deadlines from scratch —
+        # an O(G) backstop against a missed re-arm hook.  At the default
+        # 64 sweeps (~5s at the 75ms sweep cadence) the amortized cost is
+        # negligible; 0 disables the resync.
+        RESYNC_SWEEPS_KEY = "raft.tpu.upkeep.resync-sweeps"
+        RESYNC_SWEEPS_DEFAULT = 64
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Upkeep.ENABLED_KEY,
+                RaftServerConfigKeys.Upkeep.ENABLED_DEFAULT)
+
+        @staticmethod
+        def resync_sweeps(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Upkeep.RESYNC_SWEEPS_KEY,
+                RaftServerConfigKeys.Upkeep.RESYNC_SWEEPS_DEFAULT)
+
     class Metrics:
         """Per-server introspection endpoint (the cluster observability
         plane's scrape surface; no 1:1 reference analog — the reference

@@ -297,6 +297,13 @@ class TelemetrySampler:
             "fsyncs": self._fsync_reads(),
             "shed": (srv.serving.admission.shed_total
                      if getattr(srv, "serving", None) is not None else 0),
+            # upkeep plane (raft.tpu.upkeep.enabled; 0s when off): sweeps
+            # that found nothing due — the idle-cost signal the vectorized
+            # plane exists to maximize — and total vectorized sweeps
+            "upkeep_idle_skips": sum(pl.idle_skips
+                                     for pl in getattr(srv, "upkeep", [])),
+            "upkeep_sweeps": sum(pl.sweeps
+                                 for pl in getattr(srv, "upkeep", [])),
         }
 
     def _fsync_reads(self) -> int:

@@ -46,6 +46,8 @@ from ratis_tpu.protocol.raftrpc import (AppendEntriesReply,
                                         RaftRpcHeader, RequestVoteReply,
                                         RequestVoteRequest)
 from ratis_tpu.metrics.hops import hop
+from ratis_tpu.ops.upkeep import (CH_CACHE, CH_HEARTBEAT, CH_HIBERNATE,
+                                  CH_WINDOW)
 from ratis_tpu.protocol.requests import (DEFERRED_REPLY, RaftClientReply,
                                          RaftClientRequest, RequestType,
                                          reply_sink_of)
@@ -145,6 +147,13 @@ class Division:
         self.engine_slot: int = -1
         self.peer_slots: dict[RaftPeerId, int] = {}
         self.max_peers: int = server.engine.state.max_peers
+
+        # upkeep plane (raft.tpu.upkeep.enabled): this division's slot in
+        # its loop shard's packed deadline array (server/upkeep.py).  None
+        # = legacy per-group paths, bit-for-bit.
+        self._upkeep = None
+        self.upkeep_slot: int = -1
+        self.upkeep_gen: int = -1
 
         # apply loop
         self._applied_index = -1
@@ -520,6 +529,13 @@ class Division:
             if e is not None and e.is_config():
                 self.state.apply_log_entry_configuration(e)
         self.attach_engine()
+        if self.server.upkeep:
+            # register on the owning shard's plane (this coroutine already
+            # runs on the division's pinned loop, same loop as the plane's
+            # sweep — single-threaded by construction)
+            self._upkeep = self.server.upkeep_plane_for(
+                self.server.shard_of_group(self.group_id))
+            self.upkeep_slot, self.upkeep_gen = self._upkeep.register(self)
         # Decoupled-flush observers: the worker's fsync completion advances
         # flush_index -> feed the engine's commit kernel; a failed write is a
         # log failure (StateMachine.notifyLogFailed).
@@ -555,6 +571,12 @@ class Division:
 
     async def close(self) -> None:
         self._running = False
+        if self._upkeep is not None:
+            # generation bump: outstanding (slot, gen) handles — and any
+            # deadline already armed — can no longer fire into a future
+            # tenant of this slot
+            self._upkeep.unregister(self.upkeep_slot, self.upkeep_gen)
+            self._upkeep = None
         self.server.reconfiguration.unregister_all(
             self._reconfigurable_keys(), self._apply_reconfiguration)
         if self.election is not None:
@@ -725,6 +747,10 @@ class Division:
         if was_asleep:
             LOG.info("%s woke from hibernation (%s)", self.member_id,
                      reason)
+        # array mode: the wake moved the true heartbeat due-time to NOW
+        # (the force-due marker above); the packed slot must hear it or
+        # the plane would sleep out the asleep-era backstop deadline
+        self.upkeep_touch_heartbeat()
 
     @property
     def hibernating(self) -> bool:
@@ -732,6 +758,104 @@ class Division:
         (the staleness output is level-triggered; a sleeping leader's
         frozen acks would otherwise re-fire it every sweep)."""
         return self._hibernating
+
+    # --------------------------------------------------------- upkeep plane
+
+    def upkeep_touch_heartbeat(self) -> None:
+        """Arm CH_HEARTBEAT to fire at the very next sweep.  Called from
+        every event that moves the true heartbeat due-time earlier —
+        leadership start, hibernation wake, appender added — so the packed
+        deadline can only ever be conservative-EARLY (the dispatch re-runs
+        the real due gate, so early costs one declined call, never a
+        behavior change)."""
+        u = self._upkeep
+        if u is not None:
+            u.set_deadline(self.upkeep_slot, self.upkeep_gen,
+                           CH_HEARTBEAT, 0.0)
+            u.clear(self.upkeep_slot, self.upkeep_gen, CH_HIBERNATE)
+
+    def next_heartbeat_due(self, now: float) -> float:
+        """Min over appenders of the confirmed-contact due-time.  An
+        appender-less leader (single-peer group) stays on the sweep
+        cadence so hibernation quiescence counting still advances.  With
+        heartbeat coalescing OFF the legacy sweep calls every appender's
+        ``on_heartbeat_sweep`` each interval as the fill-retry waker, so
+        the slot stays due every sweep to preserve that cadence."""
+        ctx = self.leader_ctx
+        if not self.is_leader() or ctx is None:
+            return float("inf")
+        if not self.server.heartbeat_coalescing or not ctx.appenders:
+            return now if not self.server.heartbeat_coalescing \
+                else now + self.server.heartbeat_interval_s
+        return min(a.next_due(now) for a in ctx.appenders.values())
+
+    def upkeep_rearm_heartbeat(self, now: float) -> None:
+        """Post-dispatch re-arm of the leader channels from current state:
+        awake leaders arm CH_HEARTBEAT, asleep ones arm the CH_HIBERNATE
+        backstop clock instead (the slot is then touched a handful of
+        times per minute, not every sweep), non-leaders hold +inf."""
+        u = self._upkeep
+        if u is None:
+            return
+        slot, gen = self.upkeep_slot, self.upkeep_gen
+        if not self.is_leader() or self.leader_ctx is None:
+            u.clear(slot, gen, CH_HEARTBEAT)
+            u.clear(slot, gen, CH_HIBERNATE)
+        elif self._hibernating:
+            u.clear(slot, gen, CH_HEARTBEAT)
+            if self._hibernate_backstop_s > 0:
+                u.set_deadline(slot, gen, CH_HIBERNATE,
+                               self._last_hib_slow_tick
+                               + self._hibernate_backstop_s / 4)
+            else:
+                # backstop 0 = round-4 full disarm: the group costs
+                # nothing until contact wakes it
+                u.clear(slot, gen, CH_HIBERNATE)
+        else:
+            u.clear(slot, gen, CH_HIBERNATE)
+            u.set_deadline(slot, gen, CH_HEARTBEAT,
+                           self.next_heartbeat_due(now))
+
+    def upkeep_arm_cache(self, now: float) -> None:
+        """Arm the CH_CACHE expiry waterline when entries exist and the
+        channel is unarmed (write/apply paths; O(1) while armed — the
+        oldest-entry scan only runs on the empty->non-empty transition)."""
+        u = self._upkeep
+        if u is None or u.is_armed(self.upkeep_slot, self.upkeep_gen,
+                                   CH_CACHE):
+            return
+        when = min(self.retry_cache.next_expiry_s(),
+                   self.write_index_cache.next_expiry_s())
+        if when != float("inf"):
+            u.set_deadline(self.upkeep_slot, self.upkeep_gen, CH_CACHE, when)
+
+    def sweep_caches(self, now: float) -> float:
+        """CH_CACHE dispatch: run both expiry sweeps (identical bodies to
+        the legacy apply-loop slow tick) and return the new waterline —
+        +inf once both caches drain, so an idle division disarms."""
+        self.retry_cache.sweep()
+        self.write_index_cache.sweep(now)
+        return min(self.retry_cache.next_expiry_s(),
+                   self.write_index_cache.next_expiry_s())
+
+    def upkeep_arm_window(self) -> None:
+        """Arm CH_WINDOW once the reorder-window census crosses the sweep
+        threshold (the legacy per-write sweep is a no-op below it)."""
+        u = self._upkeep
+        if u is None or len(self._client_windows) <= 256 \
+                or u.is_armed(self.upkeep_slot, self.upkeep_gen, CH_WINDOW):
+            return
+        u.set_deadline(self.upkeep_slot, self.upkeep_gen, CH_WINDOW,
+                       asyncio.get_running_loop().time() + 30.0)
+
+    def sweep_client_windows_due(self) -> float:
+        """CH_WINDOW dispatch: same expiry policy as the legacy per-write
+        ``_sweep_client_windows``; next due-time, +inf when the census is
+        back under the threshold (re-armed by the next window creation)."""
+        self._sweep_client_windows(force=True)
+        if len(self._client_windows) > 256:
+            return asyncio.get_running_loop().time() + 30.0
+        return float("inf")
 
     def on_commit_advance_now(self, new_commit: int) -> None:
         """Engine advanced this group's commit (leader only).  Synchronous
@@ -902,6 +1026,10 @@ class Division:
         self.state.apply_log_entry_configuration(entry)
         self._engine_update_flush()
         self.leader_ctx.start_appenders()
+        # array mode: fresh leadership is due immediately (covers the
+        # appender-less single-peer case start_appenders' per-appender
+        # touch cannot)
+        self.upkeep_touch_heartbeat()
         LOG.info("%s became LEADER at term %d", self.member_id,
                  self.state.current_term)
 
@@ -923,6 +1051,13 @@ class Division:
                     self.member_id, leader_id)
         self._hibernating = False
         self._quiet_sweeps = 0
+        if self._upkeep is not None:
+            # non-leaders hold +inf on the leader channels — this is where
+            # the vectorized sweep's savings come from
+            self._upkeep.clear(self.upkeep_slot, self.upkeep_gen,
+                               CH_HEARTBEAT)
+            self._upkeep.clear(self.upkeep_slot, self.upkeep_gen,
+                               CH_HIBERNATE)
         if old_role == RaftPeerRole.LEADER and leader_id is None:
             # Abdication without a known successor: the stale hint still
             # names SELF, and every leader_id consumer (NotLeader
@@ -1342,7 +1477,16 @@ class Division:
             else:
                 self.server.engine.on_ack(self.engine_slot, slot,
                                           follower.match_index)
-        self._update_watch_frontiers()
+        if self._upkeep is not None:
+            # fold per-ack frontier math into one pass at the next sweep
+            # (commit-level watches stay prompt via on_commit_advance_now);
+            # same idle gate as _update_watch_frontiers — with no pending
+            # watch the numpy mark itself is hot-ack-path overhead
+            if self.watch_requests.pending_count():
+                self._upkeep.mark_watch_dirty(self.upkeep_slot,
+                                              self.upkeep_gen)
+        else:
+            self._update_watch_frontiers()
 
     def on_follower_match_regressed(self, follower: FollowerInfo) -> None:
         """A follower provably lost acked entries (volatile-log restart):
@@ -1459,7 +1603,12 @@ class Division:
                 self.server.engine.on_ack(self.engine_slot, slot, -1)
         # Heartbeat replies piggyback follower commitIndex: the *_COMMITTED
         # watch frontiers advance on them even with no new matches.
-        self._update_watch_frontiers()
+        if self._upkeep is not None:
+            if self.watch_requests.pending_count():
+                self._upkeep.mark_watch_dirty(self.upkeep_slot,
+                                              self.upkeep_gen)
+        else:
+            self._update_watch_frontiers()
 
     # ------------------------------------------------- configuration change
 
@@ -1699,7 +1848,12 @@ class Division:
                                       on_drop=self._on_window_drop)
             self._client_windows[cid] = win
         win.last_used = asyncio.get_running_loop().time()
-        self._sweep_client_windows()
+        if self._upkeep is None:
+            self._sweep_client_windows()
+        else:
+            # array mode: no per-write census walk — the plane's CH_WINDOW
+            # deadline sweeps once the census crosses the threshold
+            self.upkeep_arm_window()
         fut = asyncio.get_running_loop().create_future()
         accepted = await win.receive(req.slider_seq_num, req.slider_first,
                                      (req, fut))
@@ -1709,10 +1863,10 @@ class Division:
             return await self._write_async(req)
         return await fut
 
-    def _sweep_client_windows(self) -> None:
+    def _sweep_client_windows(self, force: bool = False) -> None:
         """Idle-window GC: the reference ties window lifetime to the client
         stream; with per-request transports we expire instead."""
-        if len(self._client_windows) <= 256:
+        if not force and len(self._client_windows) <= 256:
             return
         now = asyncio.get_running_loop().time()
         for cid, win in list(self._client_windows.items()):
@@ -2273,7 +2427,12 @@ class Division:
             # Sweep expired retry-cache entries on an interval, not per batch.
             import time as _time
             now = _time.monotonic()
-            if now - self._last_cache_sweep > self.retry_cache.expiry_s / 4:
+            if self._upkeep is not None:
+                # array mode: no per-division interval clock — the shared
+                # CH_CACHE waterline fires the sweep; this is just the O(1)
+                # arm check after a batch may have created the first entry
+                self.upkeep_arm_cache(now)
+            elif now - self._last_cache_sweep > self.retry_cache.expiry_s / 4:
                 self._last_cache_sweep = now
                 self.retry_cache.sweep()
                 # same cadence for the write-index cache: the lazy get()

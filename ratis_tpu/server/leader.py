@@ -541,6 +541,21 @@ class LogAppender:
         # catch its commit up from these very items to pass the sync gate)
         return base + (1,) if hibernate else base
 
+    def next_due(self, now: float) -> float:
+        """Earliest time ``heartbeat_item`` could next produce an item,
+        derived from the same confirmed-contact gate (upkeep plane's
+        CH_HEARTBEAT arm).  Conservative-EARLY by construction: the gate
+        re-checks at dispatch, so an early deadline costs one declined
+        call, never a changed decision — and a LATE one is impossible
+        because every input that moves the true due-time earlier
+        (wake/leadership/conf-change) sets the force-due marker or re-arms
+        the slot.  ``_last_send_s == 0.0`` is that marker: due now."""
+        if not self._last_send_s:
+            return now
+        hb = self.heartbeat_interval_s
+        return max(self.follower.last_rpc_response_s + hb * 0.9,
+                   self._last_send_s + hb * 0.45)
+
     async def on_bulk_reply(self, code: int, term: int, next_index: int,
                             follower_commit: int, flush_index: int,
                             ack_sink: Optional[list] = None) -> None:
@@ -739,6 +754,10 @@ class LeaderContext:
             lambda i=info: i.match_index,
             lambda i=info: time.monotonic() - i.last_rpc_response_s)
         appender.start()
+        # a freshly-added appender is due immediately; in array mode the
+        # division's CH_HEARTBEAT slot must hear about it or the plane
+        # would wait out the previously-armed deadline
+        self.division.upkeep_touch_heartbeat()
 
     async def remove_follower(self, peer_id: RaftPeerId) -> None:
         self.followers.pop(peer_id, None)

@@ -83,6 +83,13 @@ class WriteIndexCache:
             del self._map[cid]
         return len(dead)
 
+    def next_expiry_s(self) -> float:
+        """Oldest entry's expiry time (upkeep-plane CH_CACHE waterline);
+        +inf when empty.  O(n) only when the waterline fires."""
+        if not self._map:
+            return float("inf")
+        return min(t for _, t in self._map.values()) + self.expiry_s
+
 
 class LeaseState:
     """Host mirror of the lease decision; the expiry itself comes from the

@@ -83,12 +83,23 @@ class RetryCache:
             self._map.pop((client_id, cid), None)
 
     def sweep(self) -> int:
-        """Drop expired entries; called opportunistically by the apply loop."""
+        """Drop expired entries; called opportunistically by the apply loop
+        (or, in upkeep-plane mode, when the expiry waterline fires)."""
         now = time.monotonic()
         dead = [k for k, e in self._map.items() if self._expired(e, now)]
         for k in dead:
             del self._map[k]
         return len(dead)
+
+    def next_expiry_s(self) -> float:
+        """Oldest entry's expiry time — the upkeep plane's CH_CACHE
+        waterline.  +inf when empty, so an idle division arms nothing.
+        O(n), but only paid when the waterline actually fires (at most
+        once per expiry window per division holding entries), never on
+        the per-sweep tick."""
+        if not self._map:
+            return float("inf")
+        return min(e.created for e in self._map.values()) + self.expiry_s
 
     def __len__(self) -> int:
         return len(self._map)

@@ -64,11 +64,15 @@ class HeartbeatScheduler:
         self._task: Optional[asyncio.Task] = None
         self._running = False
         self._sweep_seq = 0
+        # array mode (raft.tpu.upkeep.enabled): this shard's UpkeepPlane;
+        # None keeps the legacy per-division walk below bit-for-bit
+        self.plane = None
 
     def start(self) -> None:
         self._running = True
         if self.service is None:
             self.service = self.server.heartbeats
+        self.plane = self.server.upkeep_plane_for(self.shard or 0)
         name = (f"heartbeats-{self.server.peer_id}" if self.shard is None
                 else f"heartbeats-{self.server.peer_id}-s{self.shard}")
         self._task = asyncio.create_task(self._run(), name=name)
@@ -100,6 +104,9 @@ class HeartbeatScheduler:
             await asyncio.sleep(self.interval_s)
             now = _time.monotonic()
             self._sweep_seq += 1
+            if self.plane is not None:
+                await self._sweep_plane(now)
+                continue
             coalesce = self.server.heartbeat_coalescing
             # destination -> ([bulk items], [appenders], aligned)
             bulk: dict[RaftPeerId, tuple[list, list]] = {}
@@ -153,6 +160,102 @@ class HeartbeatScheduler:
                                   div.member_id)
             for to, (items, appenders) in bulk.items():
                 self.service.submit(to, items, appenders)
+
+    async def _sweep_plane(self, now: float) -> None:
+        """Array-mode sweep: ONE vectorized due-scan over the shard's
+        packed deadlines, then the SAME per-division body as the legacy
+        walk — but only for the due slots.  Non-leader and asleep groups
+        hold +inf deadlines and cost nothing here."""
+        from ratis_tpu.ops.upkeep import (CH_CACHE, CH_HEARTBEAT,
+                                          CH_HIBERNATE, CH_WATCH, CH_WINDOW)
+        plane = self.plane
+        resync = self.server.upkeep_resync_sweeps
+        if resync and self._sweep_seq % resync == 0:
+            self._plane_resync(now)
+        timer = plane._timer
+        ctx = timer.time() if timer is not None else None
+        if ctx is not None:
+            ctx.__enter__()
+        try:
+            slots, mask = plane.sweep(now)
+            coalesce = self.server.heartbeat_coalescing
+            bulk: dict[RaftPeerId, tuple[list, list]] = {}
+            sweep = 0
+            for j in range(len(slots)):
+                slot = int(slots[j])
+                div = plane.division_at(slot)
+                if div is None:
+                    continue
+                gen = div.upkeep_gen
+                try:
+                    if mask[j, CH_WATCH]:
+                        plane.clear(slot, gen, CH_WATCH)
+                        div._update_watch_frontiers()
+                    if mask[j, CH_CACHE]:
+                        plane.set_deadline(slot, gen, CH_CACHE,
+                                           div.sweep_caches(now))
+                    if mask[j, CH_WINDOW]:
+                        plane.set_deadline(slot, gen, CH_WINDOW,
+                                           div.sweep_client_windows_due())
+                    if mask[j, CH_HEARTBEAT] or mask[j, CH_HIBERNATE]:
+                        sweep = await self._heartbeat_division(
+                            div, slot, now, coalesce, bulk, sweep)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    LOG.exception("upkeep sweep failed for %s",
+                                  div.member_id)
+            for to, (items, appenders) in bulk.items():
+                self.service.submit(to, items, appenders)
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+
+    async def _heartbeat_division(self, div, slot: int, now: float,
+                                  coalesce: bool, bulk: dict,
+                                  sweep: int) -> int:
+        """Identical body to one legacy-walk iteration, plus the
+        post-dispatch re-arm (``Division.upkeep_rearm_heartbeat``)."""
+        if not div.is_leader() or div.leader_ctx is None:
+            div.upkeep_rearm_heartbeat(now)  # clears the leader channels
+            return sweep
+        if (self._sweep_seq + slot) % 4 == 0:
+            # same quarter-rate phase spread as the legacy walk (slot is
+            # as stable an offset as the enumeration index was)
+            div.check_yield_to_higher_priority()
+        hib = div.hibernate_sweep(now) if coalesce else "awake"
+        if hib != "asleep":
+            for appender in list(div.leader_ctx.appenders.values()):
+                sweep += 1
+                if coalesce:
+                    item = appender.heartbeat_item(
+                        now, hibernate=(hib == "request"))
+                    if item is not None:
+                        b = bulk.setdefault(
+                            appender.follower.peer_id, ([], []))
+                        b[0].append(item)
+                        b[1].append(appender)
+                else:
+                    appender.on_heartbeat_sweep(now)
+                if sweep % 1024 == 0:
+                    # same coarse yield discipline as the legacy walk
+                    await asyncio.sleep(0)
+        div.upkeep_rearm_heartbeat(now)
+        return sweep
+
+    def _plane_resync(self, now: float) -> None:
+        """Low-rate O(G) backstop against a missed re-arm hook: re-derive
+        every registered division's deadlines from current state.  At the
+        default 64-sweep cadence (~5s) the amortized cost is negligible;
+        the hooks alone are believed sufficient — this bounds the blast
+        radius of being wrong to one resync period."""
+        plane = self.plane
+        for div in plane._divisions:  # hot-loop-gate: allowlisted resync
+            if div is None:
+                continue
+            div.upkeep_rearm_heartbeat(now)
+            div.upkeep_arm_cache(now)
+            div.upkeep_arm_window()
 
 
 class BulkHeartbeatService:
@@ -460,6 +563,30 @@ class RaftServer:
         # control + the batched readIndex scheduler, raft.tpu.serving.*.
         from ratis_tpu.server.serving import ServingPlane
         self.serving = ServingPlane(self)
+        # Vectorized upkeep plane (raft.tpu.upkeep.*): per-loop-shard
+        # packed deadline arrays replace the per-group sweep walk.  Unset
+        # keeps self.upkeep empty and every caller on the legacy paths.
+        self.upkeep: list = []
+        self.upkeep_resync_sweeps = RaftServerConfigKeys.Upkeep \
+            .resync_sweeps(p)
+        self._upkeep_info = None
+        if RaftServerConfigKeys.Upkeep.enabled(p):
+            from ratis_tpu.server.upkeep import create_planes
+            self.upkeep = create_planes(self)
+            self._upkeep_info = MetricRegistryInfo(
+                prefix=str(peer_id), application="ratis",
+                component="server", name="upkeep_plane")
+            ureg = MetricRegistries.global_registries().create(
+                self._upkeep_info)
+            sweep_timer = ureg.timer("upkeepSweepCost")
+            idle_skips = ureg.counter("upkeepIdleSkips")
+            for pl in self.upkeep:
+                pl._timer = sweep_timer
+                pl._idle_counter = idle_skips
+            ureg.gauge("upkeepDueGroups",
+                       lambda: sum(pl.last_due for pl in self.upkeep))
+            ureg.gauge("upkeepRegisteredSlots",
+                       lambda: sum(pl.registered for pl in self.upkeep))
         # single source of truth for the heartbeat cadence (LeaderContext
         # and the sweep must agree, or heartbeat gaps silently grow)
         self.heartbeat_interval_s = \
@@ -669,6 +796,10 @@ class RaftServer:
         self._lanes.clear()
         from ratis_tpu.metrics.registry import MetricRegistries
         MetricRegistries.global_registries().remove(self._plane_info)
+        if self._upkeep_info is not None:
+            MetricRegistries.global_registries().remove(self._upkeep_info)
+            self._upkeep_info = None
+        self.upkeep = []
         self.serving.close()
         await self.engine.close()
         if self.shards is not None:
@@ -863,6 +994,14 @@ class RaftServer:
         if self.shards is None:
             return 0
         return self.shards.shard_of(group_id.to_bytes())
+
+    def upkeep_plane_for(self, shard: int):
+        """The loop shard's UpkeepPlane, or None when array mode is off
+        (raft.tpu.upkeep.enabled unset) — callers fall back to the legacy
+        per-group paths."""
+        if not self.upkeep:
+            return None
+        return self.upkeep[shard]
 
     def shard_queue_depth(self, group_id: RaftGroupId) -> int:
         """Ready-callback backlog of the loop owning ``group_id``'s

@@ -8,7 +8,10 @@ That made loop count a deployment shape; this module makes the shape
 real: a :class:`LoopShardPool` runs N worker event loops (shard 0 is the
 loop the server started on; shards 1..N-1 run in daemon threads), and the
 server hash-pins every Division — and with it that division's request
-handling, appenders, heartbeat sweep share, and outbound transport
+handling, appenders, heartbeat sweep share, upkeep-plane slot
+(server/upkeep.py: the packed deadline arrays the shard's sweep scans
+are owned by the shard's loop, so registration, arming, and the
+vectorized due-scan never cross threads), and outbound transport
 connections — to one shard.
 
 No reference analog maps 1:1 (the reference is thread-per-division on a

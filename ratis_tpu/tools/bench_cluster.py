@@ -1444,6 +1444,66 @@ async def run_bench(num_groups: int, writes_per_group: int,
             await cm.__aexit__(None, None, None)
 
 
+async def run_upkeep_bench(num_groups: int = 10_240, num_servers: int = 3,
+                           settle_s: float = 25.0,
+                           teardown: bool = False) -> dict:
+    """Round-15 upkeep-plane rung: the idle-heavy multi-tenant shape —
+    ``num_groups`` hosted, NO client load, hibernation on, array mode
+    (raft.tpu.upkeep.enabled) — measured for TICK cost: the vectorized
+    plane sweep vs the retired per-division walk, back-to-back on the
+    SAME live divisions (thread-CPU best-of-3, worst server of each;
+    the _pass_cost_pair_ms pattern from round 14).  The legacy side runs
+    the pre-round-15 ``HeartbeatScheduler._run`` body verbatim, so its
+    cost includes the per-division ``hibernate_sweep`` calls an asleep
+    fleet still paid every sweep."""
+    cm = _started_cluster(num_groups, True, hibernate=True,
+                          num_servers=num_servers,
+                          extra_props={"raft.tpu.upkeep.enabled": "true"})
+    cluster = await cm.__aenter__()
+    try:
+        await asyncio.sleep(settle_s)  # let the idle fleet fall asleep
+
+        def legacy_tick(srv) -> None:
+            now = time.monotonic()
+            for div in list(srv.divisions.values()):
+                if not div.is_leader() or div.leader_ctx is None:
+                    continue
+                hib = div.hibernate_sweep(now)
+                if hib == "asleep":
+                    continue
+                for appender in list(div.leader_ctx.appenders.values()):
+                    appender.heartbeat_item(now,
+                                            hibernate=(hib == "request"))
+
+        def array_tick(srv) -> None:
+            now = time.monotonic()
+            for pl in srv.upkeep:
+                pl.sweep(now)
+
+        array_worst = legacy_worst = 0.0
+        asleep = registered = due = 0
+        for srv in cluster.servers:
+            array_worst = max(array_worst, _blocking_best_of_3(
+                lambda: array_tick(srv)))
+            legacy_worst = max(legacy_worst, _blocking_best_of_3(
+                lambda: legacy_tick(srv)))
+            asleep += sum(1 for d in srv.divisions.values()
+                          if d._hibernating)
+            registered += sum(pl.registered for pl in srv.upkeep)
+            due += sum(pl.last_due for pl in srv.upkeep)
+        return {
+            "groups": num_groups, "peers": num_servers,
+            "hibernated_groups": asleep,
+            "registered_slots": registered, "due_groups": due,
+            "tick_array_ms": round(array_worst * 1e3, 3),
+            "tick_legacy_ms": round(legacy_worst * 1e3, 3),
+            "tick_ratio": round(legacy_worst / max(1e-9, array_worst), 1),
+        }
+    finally:
+        if teardown:
+            await cm.__aexit__(None, None, None)
+
+
 async def run_churn_bench(num_groups: int, writes_per_group: int,
                           transfers: int, batched: bool = True,
                           concurrency: int = 128) -> dict:

@@ -1,0 +1,66 @@
+"""tools/check_hot_loops: the static gate that keeps the O(G) per-group
+Python walk from creeping back into the tick/sweep modules after PR 15
+vectorized it away."""
+
+import ast
+
+from ratis_tpu.tools import check_hot_loops as gate
+
+
+def test_repo_hot_loops_all_allowlisted():
+    assert gate.check() == []
+
+
+def test_new_divisions_walk_is_flagged(tmp_path):
+    src = (
+        "class Scheduler:\n"
+        "    async def _run(self):\n"
+        "        for div in list(self.server.divisions.values()):\n"
+        "            div.tick()\n"
+    )
+    (tmp_path / "mod.py").write_text(src)
+    problems = gate.check(repo=str(tmp_path), scanned=("mod.py",),
+                          allowlist={})
+    assert len(problems) == 1
+    assert "Scheduler._run" in problems[0] and "mod.py:3" in problems[0]
+
+
+def test_comprehension_walk_is_flagged():
+    src = (
+        "def sample(server):\n"
+        "    return [d.lag for d in server.divisions.values()]\n"
+    )
+    sites = gate.scan_source("mod.py", src)
+    assert sites == [("mod.py", "sample", 2)]
+
+
+def test_allowlisted_walk_passes_and_stale_entry_fails(tmp_path):
+    src = (
+        "def shutdown(server):\n"
+        "    for d in server.divisions.values():\n"
+        "        d.close()\n"
+    )
+    (tmp_path / "mod.py").write_text(src)
+    ok = gate.check(repo=str(tmp_path), scanned=("mod.py",),
+                    allowlist={("mod.py", "shutdown"): "shutdown only"})
+    assert ok == []
+    stale = gate.check(
+        repo=str(tmp_path), scanned=("mod.py",),
+        allowlist={("mod.py", "shutdown"): "shutdown only",
+                   ("mod.py", "gone_function"): "no longer exists"})
+    assert len(stale) == 1 and "stale allowlist" in stale[0]
+
+
+def test_loop_free_module_is_clean(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    assert gate.check(repo=str(tmp_path), scanned=("mod.py",),
+                      allowlist={}) == []
+
+
+def test_gate_scans_the_sweep_modules():
+    # the modules the ISSUE names as hot paths must stay under the gate
+    for rel in ("ratis_tpu/server/server.py",
+                "ratis_tpu/server/division.py",
+                "ratis_tpu/server/leader.py",
+                "ratis_tpu/server/upkeep.py"):
+        assert rel in gate.SCANNED

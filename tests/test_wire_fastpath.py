@@ -462,7 +462,10 @@ def test_bench_summary_line_fits_driver_window():
                                "sampler_pass_ms": 9999.999,
                                "ledger_fetch_ms": 9999.999,
                                "walk_pass_ms": 9999.999}),
-        tel_off=rung())
+        tel_off=rung(),
+        # realistic-worst width: the idle scan measures in MICROseconds
+        # (tests/test_upkeep.py); 9.999ms is already a 1000x degradation
+        upkeep=[9.999, 9.999, 0.99])
     line = json.dumps(summary, separators=(",", ":"))
     assert len(line) < 2000, f"bench line would overflow: {len(line)} chars"
     parsed = json.loads(line)
@@ -495,6 +498,11 @@ def test_bench_summary_line_fits_driver_window():
     # recovery-throughput fraction, injected-fault event records]
     assert parsed["secondary"]["chaos"] == [9, 9, 9999.999, 99.999,
                                                  99999]
+    # round-15 upkeep plane: [sweep ms @64 slots, @1024, sim dip frac]
+    assert parsed["secondary"]["upkeep"] == [9.999, 9.999, 0.99]
+    # kernel throughputs are COUNTS: emitted rounded to the integer
+    assert parsed["secondary"]["kernel"][0] == 1330708656
+    assert parsed["secondary"]["kernel_100k"] == 1333027867
     # compact list forms: grpc_1024 = [cps, p99, scalar cps, s256 cps],
     # mesh_10240 = [cps, spread, sim cps, sim spread]
     assert parsed["secondary"]["grpc_1024"][0] == 123456.8
