@@ -389,6 +389,29 @@ def child_zipf() -> None:
     asyncio.run(main())
 
 
+def child_placement() -> None:
+    """Placement closed-loop rung (round-16): zipf fleet with a pinned
+    leadership hotspot plus an induced grey follower, measured
+    back-to-back with the placement controller OFF then ON — hot-server
+    shed count and p99 before/after, leadership transfers issued, and
+    the fraction of linearizable-read confirmations steered off the grey
+    peer (run_placement_bench)."""
+    _force_cpu_platform()
+    import asyncio
+
+    from ratis_tpu.tools.bench_cluster import run_placement_bench
+
+    async def main():
+        out = await run_placement_bench(num_groups=48, clients=384,
+                                        requests_per_client=6,
+                                        pace_s=0.25, transport="tcp",
+                                        num_servers=4, element_limit=192,
+                                        hot_pins=8, settle_s=6.0)
+        print("RESULT " + json.dumps(out))
+
+    asyncio.run(main())
+
+
 def child_snapcatch() -> None:
     """InstallSnapshot-under-load rung at 1024 groups (VERDICT Missing
     #5): snapshot+purge the leaders, wipe one server's replicas, measure
@@ -793,6 +816,11 @@ def main() -> None:
     # typed replies while the served tail stays bounded.
     zipf = _run_child(["--zipf-child"], timeout_s=1800.0,
                       allow_dnf=True)
+    # Round-16 placement plane: the closed control loop measured — the
+    # same zipf fleet with a pinned leadership hotspot and an induced
+    # grey follower, controller OFF then ON on identical offered load.
+    placement = _run_child(["--placement-child"], timeout_s=1800.0,
+                           allow_dnf=True)
     # Round-15 upkeep plane: (a) the 64->1024 sim dip pair with array
     # mode ON, back-to-back with the (OFF) ladder rungs above — the dip
     # fraction is THE per-group host-bookkeeping tax made visible; (b)
@@ -845,7 +873,8 @@ def main() -> None:
         kernel_100k=kernel_100k, tpu_e2e=tpu_e2e, traced=traced,
         filestore5=filestore5, readmix=readmix, snapcatch=snapcatch,
         win_sweep=win_sweep, chaos=chaos, tel_on=tel_on,
-        tel_off=tel_off, zipf=zipf, upkeep=upkeep),
+        tel_off=tel_off, zipf=zipf, upkeep=upkeep,
+        placement=placement),
         separators=(",", ":")))
 
 
@@ -989,6 +1018,17 @@ def _write_definition() -> None:
         "oracle, catch-up under load); a failing scenario's (seed, "
         "scenario, journal) artifact replays bit-for-bit via "
         "ratis_tpu.tools.chaos_replay (docs/chaos.md).\n"
+        "- secondary.placement: round-16 placement controller closed "
+        "loop (ratis_tpu/placement/; raft.tpu.placement.*): the zipf "
+        "fleet with a pinned leadership hotspot plus an induced grey "
+        "follower, controller OFF then ON under identical open-loop "
+        "offered load — [hot-server write p99 ms with the controller "
+        "OFF, ON (acceptance: ON <= 0.8x OFF), leadership transfers "
+        "the actuator issued, fraction of linearizable-read "
+        "confirmations steered off the grey peer].  Hot-server shed "
+        "counts (off/on), grey confirmation shares, plansComputed and "
+        "the explainable plan ride in the rung's own RESULT record "
+        "(docs/placement.md).\n"
         % (HEADLINE_TRIALS, HEADLINE_GROUPS))
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1012,12 +1052,18 @@ def _compact_decomp(block, client=None) -> dict:
              ("server.apply", "apply"), ("server.reply", "reply"),
              ("server.respond", "resp"))
     stages = block.get("stages", {})
-    out = {s: stages[k]["p50_us"] for k, s in short if k in stages}
+
+    def us(v):
+        # sub-us decimals only carry information at small magnitudes;
+        # past 1ms they just widen the line (the 2000-char window)
+        return round(v) if v >= 1000 else v
+
+    out = {s: us(stages[k]["p50_us"]) for k, s in short if k in stages}
     out["cov"] = block.get("coverage", 0.0)
     if isinstance(client, dict):
         cs = client.get("stages", {}).get("client.send")
         if cs:
-            out["cw"] = cs["p50_us"]
+            out["cw"] = us(cs["p50_us"])
     return out
 
 
@@ -1038,7 +1084,7 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
                kernel_100k, tpu_e2e, traced, filestore5, readmix,
                snapcatch, win_sweep=None, chaos=None, tel_on=None,
                tel_off=None, mixed_fs=None, zipf=None,
-               upkeep=None) -> dict:
+               upkeep=None, placement=None) -> dict:
     """Build the one-line JSON summary.  COMPACT by contract: the whole
     line must parse from the driver's 2000-char tail window (r5 lost its
     flagship number to overflow), so keys are short, numbers rounded, and
@@ -1229,6 +1275,16 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
             "zipf": ({"dnf": True} if zipf is None or zipf.get("dnf") else
                      [zipf["writes_per_sec"], zipf["reads_per_sec"],
                       zipf["shed_frac"], zipf.get("p99_ms")]),
+            # round-16 placement plane, closed-loop rung: [hot-server
+            # p99 ms controller OFF, ON, leadership transfers issued,
+            # grey read-steer fraction]; shed counts, grey confirmation
+            # shares and the full plan stay in the rung's RESULT record
+            "placement": (
+                {"dnf": True} if placement is None or placement.get("dnf")
+                else [placement["hotspot_p99_before_ms"],
+                      placement["hotspot_p99_after_ms"],
+                      placement["transfers"],
+                      placement["grey_steer_frac"]]),
             # wipe-one-server catch-up: [catchup s, chunked installs,
             # commits/s during installs, commits/s before]
             "snap_1024": ({"dnf": True} if snapcatch.get("dnf") else
@@ -1260,7 +1316,7 @@ def _summarize(*, headline, scalar, ladder, mesh_trials, peer5,
             ],
             "tpu_e2e": (
                 {"dnf": True, "err": str(tpu_e2e.get(
-                    "reason", tpu_e2e.get("timeout_s", "")))[:40]}
+                    "reason", tpu_e2e.get("timeout_s", "")))[:32]}
                 if tpu_e2e.get("dnf") else
                 {"cps": tpu_e2e["commits_per_sec"],
                  "p50": tpu_e2e["p50_ms"]}),
@@ -1302,6 +1358,8 @@ if __name__ == "__main__":
         child_snapcatch()
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-child":
         child_zipf()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--placement-child":
+        child_placement()
     elif len(sys.argv) > 1 and sys.argv[1] == "--upkeep-child":
         child_upkeep(sys.argv[2] if len(sys.argv) > 2 else "{}")
     elif len(sys.argv) > 1 and sys.argv[1] == "--chaos-child":

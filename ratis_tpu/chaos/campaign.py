@@ -37,7 +37,7 @@ DEFAULT_CAMPAIGN = ("partition_minority", "partition_leader",
                     "asymmetric_partition", "link_degraded",
                     "crash_restart_follower", "crash_restart_leader",
                     "leader_churn_storm", "slow_follower",
-                    "grey_follower")
+                    "grey_follower", "rebalance_storm")
 DURABLE_EXTRA = ("slow_disk", "shared_log_tail_loss")
 
 
@@ -139,7 +139,8 @@ async def run_campaign(num_servers: int = 3, num_groups: int = 1,
                                       if e["kind"] == "fault-recovered")
         out["organic_events"] = sum(
             1 for e in events
-            if e["kind"] not in ("injected-fault", "fault-recovered"))
+            if e["kind"] not in ("injected-fault", "fault-recovered",
+                                 "rebalance", "rebalance-done"))
     finally:
         await cluster.close()
     return out

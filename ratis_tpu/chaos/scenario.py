@@ -407,6 +407,7 @@ class ScenarioRunner:
         link_faults().reseed(sc.seed)
         self._arm_injections()
         self._arm_grey()
+        self._arm_rebalance()
         writers = _Writers(self.cluster, sc.config,
                            tag=f"{sc.name}.{sc.seed}.")
         # a quiesced start anchors the counter-delta oracle (and keeps a
@@ -439,6 +440,10 @@ class ScenarioRunner:
             for victim in list(self._killed):
                 self._killed.remove(victim)
                 await self.cluster.restart(victim)
+            # the storm's controllers go down WITH the faults: closing
+            # here lets any in-flight actuation finish (or journal its
+            # aborted pair) before the pairing SLO is checked
+            await self._disarm_rebalance()
 
             # ---------------------------------- recovery SLOs under load
             try:
@@ -494,6 +499,7 @@ class ScenarioRunner:
             link_faults().heal_all()
             self._disarm_injections()
             self._disarm_grey()
+            await self._disarm_rebalance()
             await writers.stop()
             for victim in list(self._killed):
                 self._killed.remove(victim)
@@ -604,6 +610,8 @@ class ScenarioRunner:
                  f"not silent drops")
         if sc.config.get("expect_grey"):
             self._verify_grey()
+        if sc.config.get("expect_rebalance"):
+            self._verify_rebalance()
 
     # ------------------------------------------------- grey-follower SLO
 
@@ -677,6 +685,102 @@ class ScenarioRunner:
         assert not unpaired, \
             (f"[seed {seed}] {len(unpaired)} grey episode(s) never "
              f"closed: {[e['fault'] for e in unpaired]}")
+
+
+    # ---------------------------------------------- rebalance-storm SLO
+
+    def _arm_rebalance(self) -> None:
+        """Start a PlacementController on every server (armed thresholds:
+        short interval, zero hysteresis, a near-zero hot-share floor) and
+        retune the lag ledger so the scenario's slow follower actually
+        scores low — the storm asserts the controller keeps actuating,
+        and pairing every actuation, WHILE the faults are live.  Torn
+        down in _disarm_rebalance (called at heal and again in the
+        finally, idempotently)."""
+        cfg = self.scenario.config
+        if not cfg.get("expect_rebalance"):
+            return
+        from ratis_tpu.placement import PlacementController
+        self._rebalance_ctrls: dict = {}
+        self._rebalance_saved: dict = {}
+        self._rebalance_base: dict = {}
+        for name, srv in self.cluster.servers.items():
+            wd = srv.watchdog
+            if wd is None:
+                continue
+            led = srv.engine.ledger
+            self._rebalance_saved[name] = (led.lag_threshold,
+                                           led.up_window_ms)
+            led.lag_threshold = int(cfg.get("rebalance_lag_entries", 2))
+            led.up_window_ms = int(cfg.get("rebalance_up_window_ms", 8000))
+            self._rebalance_base[name] = wd.last_seq
+            ctrl = PlacementController(
+                srv,
+                interval_s=float(cfg.get("rebalance_interval_s", 0.3)),
+                cooldown_s=float(cfg.get("rebalance_cooldown_s", 1.0)),
+                max_per_round=int(cfg.get("rebalance_max_per_round", 2)),
+                hot_share=float(cfg.get("rebalance_hot_share", 0.01)),
+                hysteresis=0.0, steer_ttl_s=2.0, transfer_timeout_s=2.0)
+            ctrl.start()
+            srv.placement = ctrl
+            self._rebalance_ctrls[name] = ctrl
+
+    async def _disarm_rebalance(self) -> None:
+        """Close every storm controller (idempotent: a killed server's
+        close() already shut its controller down; re-closing is a no-op)
+        and restore the retuned ledger thresholds on surviving servers."""
+        for name, ctrl in list(getattr(self,
+                                       "_rebalance_ctrls", {}).items()):
+            try:
+                await ctrl.close()
+            except Exception:
+                LOG.exception("closing storm controller on %s failed",
+                              name)
+            srv = self.cluster.servers.get(name)
+            if srv is not None and srv.placement is ctrl:
+                srv.placement = None
+        self._rebalance_ctrls = {}
+        for name, saved in getattr(self,
+                                   "_rebalance_saved", {}).items():
+            srv = self.cluster.servers.get(name)
+            if srv is None:
+                continue
+            (srv.engine.ledger.lag_threshold,
+             srv.engine.ledger.up_window_ms) = saved
+        self._rebalance_saved = {}
+
+    def _verify_rebalance(self) -> None:
+        """The storm SLO: the controller actuated at least once during
+        the fault window, and EVERY rebalance event has its
+        rebalance-done pair (a dangling actuation means the actuator
+        dropped an outcome on the floor).  Journals live on the servers
+        that emitted them: a killed server's journal died with it, so
+        pairing is asserted per surviving journal — both halves of a
+        pair always land in the same ring."""
+        from ratis_tpu.server.watchdog import (KIND_REBALANCE,
+                                               KIND_REBALANCE_DONE)
+        seed = self.scenario.seed
+        opened, closed = [], []
+        for name, srv in self.cluster.servers.items():
+            wd = srv.watchdog
+            if wd is None:
+                continue
+            base = self._rebalance_base.get(name, -1)
+            for e in wd.events(since=base):
+                if e["kind"] == KIND_REBALANCE:
+                    opened.append(e)
+                elif e["kind"] == KIND_REBALANCE_DONE:
+                    closed.append(e)
+        self.result.checks["rebalance_events"] = len(opened)
+        self.result.checks["rebalance_done"] = len(closed)
+        assert opened, \
+            (f"[seed {seed}] rebalance storm drove no actuations: the "
+             f"controller never steered or transferred under the faults")
+        done_ids = {e.get("fault") for e in closed}
+        unpaired = [e for e in opened if e.get("fault") not in done_ids]
+        assert not unpaired, \
+            (f"[seed {seed}] {len(unpaired)} rebalance actuation(s) "
+             f"never converged: {[e['fault'] for e in unpaired]}")
 
 
 async def run_scenario(cluster, scenario: Scenario,

@@ -301,6 +301,34 @@ def _grey_follower(rng: random.Random, cfg: dict) -> tuple:
             make_step(t + hold, "heal"))
 
 
+@_scenario("rebalance_storm")
+def _rebalance_storm(rng: random.Random, cfg: dict) -> tuple:
+    """Placement controller under fire (``expect_rebalance`` arms a
+    PlacementController per server with storm thresholds: zero
+    hysteresis, near-zero hot-share, sub-second rounds): moderate
+    latency on one follower's links makes it score grey/laggy (steering
+    fires) while the skewed write load keeps the hot set moving (so
+    transfer actuations race the faults), then a SECOND follower crashes
+    and restarts mid-storm — quorum survives through the leader plus the
+    slow follower, and every controller actuation (including any a dying
+    transfer aborted) must land with its rebalance-done pair.  SLO = the
+    usual zero lost acks + exactly-once + convergence, plus the pairing
+    check.  Load stays concentrated so commit deltas register in every
+    ledger pass, same as grey_follower."""
+    cfg["expect_rebalance"] = True
+    cfg["active_groups"] = min(int(cfg.get("active_groups", 8) or 8), 8)
+    hold = _hold(cfg, round(rng.uniform(2.5, 3.5), 2))
+    t = _WARM_S + rng.uniform(0, 0.3)
+    down = _hold(cfg, round(rng.uniform(0.8, 1.4), 2))
+    return (make_step(t, "link", "follower:0",
+                      latency_ms=round(rng.uniform(150, 250), 1),
+                      jitter_ms=round(rng.uniform(20, 50), 1),
+                      drop_rate=0.0),
+            make_step(t + 0.6, "kill", "follower:1"),
+            make_step(t + 0.6 + down, "restart"),
+            make_step(t + hold, "heal"))
+
+
 @_scenario("window_crash")
 def _window_crash(rng: random.Random, cfg: dict) -> tuple:
     """Round-9 window-protocol recovery: slow a follower so depth>1

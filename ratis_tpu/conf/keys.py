@@ -727,6 +727,97 @@ class RaftServerConfigKeys:
                 RaftServerConfigKeys.Lag.TOP_GROUPS_KEY,
                 RaftServerConfigKeys.Lag.TOP_GROUPS_DEFAULT)
 
+    class Placement:
+        """Placement controller (ratis_tpu.placement; reference analog:
+        TiKV's Placement Driver pattern over exactly this shape —
+        telemetry-scored leadership transfers and read steering on a
+        multi-raft host).  ``enabled`` unset (the default) creates
+        nothing: no loop, no registry, identical request paths.  When
+        on, one scoring pass per ``interval`` consumes the
+        already-fetched ledger/sketch data (O(servers + k) python, no
+        divisions walk), emits an explainable plan, and actuates it
+        rate-limited: at most ``max-transfers-per-round`` leadership
+        transfers, each group then held out for ``cooldown``;
+        ``hysteresis`` is the extra hot-leads margin a server must
+        exceed over its fair share before it sheds (the anti-ping-pong
+        band).  ``hot-share`` is the sketch share_min floor for a group
+        to count as hot; peers scoring under ``grey-score`` (or inside
+        a watchdog grey episode) are steered away from as readIndex
+        confirmation targets for ``steer-ttl`` per actuation."""
+
+        ENABLED_KEY = "raft.tpu.placement.enabled"
+        ENABLED_DEFAULT = False
+        INTERVAL_KEY = "raft.tpu.placement.interval"
+        INTERVAL_DEFAULT = TimeDuration.valueOf("2s")
+        MAX_TRANSFERS_KEY = "raft.tpu.placement.max-transfers-per-round"
+        MAX_TRANSFERS_DEFAULT = 2
+        COOLDOWN_KEY = "raft.tpu.placement.cooldown"
+        COOLDOWN_DEFAULT = TimeDuration.valueOf("30s")
+        HYSTERESIS_KEY = "raft.tpu.placement.hysteresis"
+        HYSTERESIS_DEFAULT = 1.0
+        HOT_SHARE_KEY = "raft.tpu.placement.hot-share"
+        HOT_SHARE_DEFAULT = 0.2
+        GREY_SCORE_KEY = "raft.tpu.placement.grey-score"
+        GREY_SCORE_DEFAULT = 0.5
+        STEER_TTL_KEY = "raft.tpu.placement.steer-ttl"
+        STEER_TTL_DEFAULT = TimeDuration.valueOf("10s")
+        TRANSFER_TIMEOUT_KEY = "raft.tpu.placement.transfer-timeout"
+        TRANSFER_TIMEOUT_DEFAULT = TimeDuration.valueOf("3s")
+
+        @staticmethod
+        def enabled(p: RaftProperties) -> bool:
+            return p.get_boolean(
+                RaftServerConfigKeys.Placement.ENABLED_KEY,
+                RaftServerConfigKeys.Placement.ENABLED_DEFAULT)
+
+        @staticmethod
+        def interval(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Placement.INTERVAL_KEY,
+                RaftServerConfigKeys.Placement.INTERVAL_DEFAULT)
+
+        @staticmethod
+        def max_transfers(p: RaftProperties) -> int:
+            return p.get_int(
+                RaftServerConfigKeys.Placement.MAX_TRANSFERS_KEY,
+                RaftServerConfigKeys.Placement.MAX_TRANSFERS_DEFAULT)
+
+        @staticmethod
+        def cooldown(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Placement.COOLDOWN_KEY,
+                RaftServerConfigKeys.Placement.COOLDOWN_DEFAULT)
+
+        @staticmethod
+        def hysteresis(p: RaftProperties) -> float:
+            return p.get_float(
+                RaftServerConfigKeys.Placement.HYSTERESIS_KEY,
+                RaftServerConfigKeys.Placement.HYSTERESIS_DEFAULT)
+
+        @staticmethod
+        def hot_share(p: RaftProperties) -> float:
+            return p.get_float(
+                RaftServerConfigKeys.Placement.HOT_SHARE_KEY,
+                RaftServerConfigKeys.Placement.HOT_SHARE_DEFAULT)
+
+        @staticmethod
+        def grey_score(p: RaftProperties) -> float:
+            return p.get_float(
+                RaftServerConfigKeys.Placement.GREY_SCORE_KEY,
+                RaftServerConfigKeys.Placement.GREY_SCORE_DEFAULT)
+
+        @staticmethod
+        def steer_ttl(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Placement.STEER_TTL_KEY,
+                RaftServerConfigKeys.Placement.STEER_TTL_DEFAULT)
+
+        @staticmethod
+        def transfer_timeout(p: RaftProperties) -> TimeDuration:
+            return p.get_time_duration(
+                RaftServerConfigKeys.Placement.TRANSFER_TIMEOUT_KEY,
+                RaftServerConfigKeys.Placement.TRANSFER_TIMEOUT_DEFAULT)
+
     class Chaos:
         """Chaos campaign subsystem (ratis_tpu.chaos; reference analogs:
         RaftExceptionBaseTest, the kill/restart suites over simulated RPC,

@@ -450,17 +450,28 @@ class TelemetrySampler:
             except (TypeError, ValueError):
                 n = None
         total = max(1, self.sketch.total)
-        groups = [{
-            "group": str(e["key"]),
-            "commits": e["count"],
-            "err": e["err"],
-            "pending": e["aux"] or 0,
-            "share": round(e["count"] / total, 4),
-            # guaranteed lower bound (count - err)/total: under uniform
-            # load this reads ~0 while `share` reads the sketch's ~1/k
-            # overestimate floor — share_min is the honest skew signal
-            "share_min": round(max(0, e["count"] - e["err"]) / total, 4),
-        } for e in self.sketch.top(n)]
+        srv = self.server
+        groups = []
+        for e in self.sketch.top(n):
+            gid = e["key"]
+            div = srv.divisions.get(gid)
+            groups.append({
+                "group": str(gid),
+                "commits": e["count"],
+                "err": e["err"],
+                "pending": e["aux"] or 0,
+                "share": round(e["count"] / total, 4),
+                # guaranteed lower bound (count - err)/total: under
+                # uniform load this reads ~0 while `share` reads the
+                # sketch's ~1/k overestimate floor — share_min is the
+                # honest skew signal
+                "share_min": round(
+                    max(0, e["count"] - e["err"]) / total, 4),
+                # placement facts: does THIS server lead the group, and
+                # on which loop shard does it live here
+                "led": div is not None and div.is_leader(),
+                "shard": srv.shard_of_group(gid),
+            })
         return {
             "peer": str(self.server.peer_id),
             "pid": __import__("os").getpid(),

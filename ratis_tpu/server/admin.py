@@ -205,6 +205,12 @@ async def transfer_leadership(div, req: RaftClientRequest) -> RaftClientReply:
     err = div._check_leader(req)
     if err is not None:
         return err
+    if div.hibernating:
+        # a hibernated leader sends no heartbeats and its followers hold
+        # no armed election timers — the handover below (catch-up wait +
+        # StartLeaderElection) would stall against sleeping appenders, so
+        # wake the group before transferring
+        div.wake_from_hibernation("transfer-leadership")
     div.election_metrics.transfer_count.inc()
     try:
         args = TransferLeadershipArguments.from_payload(req.message.content)

@@ -101,3 +101,42 @@ class LeaseState:
 
     def is_valid(self, now_ms: int, lease_expiry_ms: int) -> bool:
         return self.enabled and now_ms < lease_expiry_ms
+
+
+class ReadSteering:
+    """Per-server readIndex steering table (the placement actuator's
+    lease/read hook): peer name -> monotonic avoid-until expiry.  The
+    batched confirmation sweep deprioritizes the listed peers as
+    confirmation targets — per group, only when enough unsteered voters
+    remain to still reach majority, so a steered peer is never traded
+    for availability.  Always constructed (empty-dict checks are free);
+    only the placement actuator ever populates it."""
+
+    def __init__(self):
+        self._avoid: dict[str, float] = {}
+        self.steered = 0  # confirmation sends skipped off steered peers
+
+    def steer(self, peer: str, ttl_s: float,
+              now: Optional[float] = None) -> bool:
+        """Avoid ``peer`` for ``ttl_s``; True only when this opens a NEW
+        steering episode (renewals extend silently — the actuator
+        journals/counts per episode, not per policy round)."""
+        if now is None:
+            now = time.monotonic()
+        fresh = self._avoid.get(peer, 0.0) <= now
+        self._avoid[peer] = now + max(0.0, ttl_s)
+        return fresh
+
+    def clear(self, peer: str) -> None:
+        self._avoid.pop(peer, None)
+
+    def avoided(self, now: Optional[float] = None) -> set:
+        """Currently-steered peer names (expired entries pruned)."""
+        if not self._avoid:
+            return set()
+        if now is None:
+            now = time.monotonic()
+        dead = [p for p, t in self._avoid.items() if t <= now]
+        for p in dead:
+            del self._avoid[p]
+        return set(self._avoid)

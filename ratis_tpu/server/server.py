@@ -484,6 +484,11 @@ class RaftServer:
         # when enabled — off is zero-cost, identical paths.
         self.telemetry = None
         self.flight = None
+        # Placement controller (raft.tpu.placement.enabled): the opt-in
+        # telemetry-driven rebalancing loop, created in start() — unset
+        # keeps every request/read path bit-identical to a build without
+        # the subsystem.
+        self.placement = None
         from ratis_tpu.conf.reconfiguration import ReconfigurationManager
         # live property reconfiguration (divisions register their knobs)
         self.reconfiguration = ReconfigurationManager(properties)
@@ -563,6 +568,11 @@ class RaftServer:
         # control + the batched readIndex scheduler, raft.tpu.serving.*.
         from ratis_tpu.server.serving import ServingPlane
         self.serving = ServingPlane(self)
+        # readIndex steering table (server/read.py): always constructed
+        # (an empty table is a free set() check in the sweep); only the
+        # placement actuator ever populates it.
+        from ratis_tpu.server.read import ReadSteering
+        self.read_steering = ReadSteering()
         # Vectorized upkeep plane (raft.tpu.upkeep.*): per-loop-shard
         # packed deadline arrays replace the per-group sweep walk.  Unset
         # keeps self.upkeep empty and every caller on the legacy paths.
@@ -685,6 +695,11 @@ class RaftServer:
             json_routes["/hotgroups"] = self.telemetry.hotgroups_info
             json_routes["/flightrecorder"] = \
                 self.flight.flightrecorder_info
+        if _K.Placement.enabled(self.properties):
+            from ratis_tpu.placement import PlacementController
+            self.placement = PlacementController(self)
+            self.placement.start()
+            json_routes["/placement"] = self.placement.placement_info
         http_port = _K.Metrics.http_port(self.properties)
         if http_port is not None:
             from ratis_tpu.metrics.prometheus import MetricsHttpServer
@@ -740,6 +755,11 @@ class RaftServer:
         if self.metrics_http is not None:
             await self.metrics_http.close()
             self.metrics_http = None
+        # the placement loop goes down before the watchdog: an in-flight
+        # actuation still journals its aborted pair on cancellation
+        if self.placement is not None:
+            await self.placement.close()
+            self.placement = None
         if self.telemetry is not None:
             if self.flight is not None:
                 from ratis_tpu.metrics.flight import uninstall_sigterm_dump
@@ -1075,9 +1095,30 @@ class RaftServer:
                   if _inj.is_registered(p)]
         return {"activeLinkFaults": links, "activeInjections": points}
 
-    def divisions_info(self) -> list:
+    def divisions_info(self, query=None):
         """GET /divisions: per-division introspection (role, term,
-        commit/applied, follower lag, cache sizes, shard placement)."""
+        commit/applied, follower lag, cache sizes, shard placement).
+        ``?rollup=1`` returns the cheap per-server rollup instead —
+        leadership count, total pending, shard occupancy vector — the
+        O(servers) payload the placement frontends aggregate without
+        shipping (or parsing) every division's full introspection."""
+        if query and query.get("rollup", [None])[0]:
+            n_shards = self.shards.n if self.shards is not None else 1
+            shard_counts = [0] * n_shards
+            leading = pending = hibernating = 0
+            for div in list(self.divisions.values()):
+                shard_counts[self.shard_of_group(div.group_id)
+                             % n_shards] += 1
+                if div.hibernating:
+                    hibernating += 1
+                if div.is_leader() and div.leader_ctx is not None:
+                    leading += 1
+                    pending += len(div.leader_ctx.pending)
+            import os
+            return {"peer": str(self.peer_id), "pid": os.getpid(),
+                    "divisions": len(self.divisions),
+                    "leading": leading, "pendingTotal": pending,
+                    "hibernating": hibernating, "shards": shard_counts}
         return [div.introspect()
                 for div in list(self.divisions.values())]
 

@@ -85,6 +85,15 @@ class AdmissionController:
         exempt admin request type)."""
         if not self.enabled or request.type.type not in _BUDGETED:
             return None, None
+        div = self.server.divisions.get(request.group_id)
+        if div is not None and not div.is_leader():
+            # a group this server does not lead holds no pending
+            # capacity: the division replies NotLeader (or serves a
+            # stale read locally) without entering the commit pipeline.
+            # Shedding here would hide the redirect hint — after a
+            # leadership transfer the old leader would trap its clients
+            # in retry-after loops instead of healing their routing
+            return None, None
         shard = self.server.shard_of_group(request.group_id)
         nbytes = len(request.message.content) if request.message else 0
         count = self.pending_count[shard]

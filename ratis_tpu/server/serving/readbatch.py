@@ -68,6 +68,9 @@ class ReadIndexScheduler:
         self._shards: dict[int, _ShardState] = {}
         self.sweeps = 0       # batched confirmation rounds fired
         self.confirmed = 0    # reads whose confirmation rode a sweep
+        # destination peer name -> confirmation group-requests sent (the
+        # placement bench's grey-confirmation-share denominator)
+        self.confirm_sent: dict[str, int] = {}
 
     def confirm(self, division) -> asyncio.Future:
         """Future resolving when ``division``'s leadership is confirmed by
@@ -118,6 +121,9 @@ class ReadIndexScheduler:
         acks: dict = {}      # group_id -> acks seen
         # destination peer id -> list of (group_id, AppendEntriesRequest)
         by_dest: dict = {}
+        # placement steering: peers to deprioritize as confirmation
+        # targets this sweep (empty set on the default paths)
+        avoid = self.server.read_steering.avoided()
         for gid, entry in batch.items():
             div = entry.division
             if div.leader_ctx is None:
@@ -133,6 +139,14 @@ class ReadIndexScheduler:
                 continue
             need[gid] = len(conf.voting_peers()) // 2 + 1 - 1  # minus self
             acks[gid] = 0
+            if avoid:
+                # skip steered (grey/laggy) peers only while the
+                # remaining voters can still reach this group's majority
+                preferred = [p for p in others if str(p.id) not in avoid]
+                if len(preferred) >= need[gid]:
+                    self.server.read_steering.steered += \
+                        len(others) - len(preferred)
+                    others = preferred
             log = div.state.log
             prev = log.get_last_entry_term_index()
             commit = log.get_last_committed_index()
@@ -141,6 +155,10 @@ class ReadIndexScheduler:
                     RaftRpcHeader(div.member_id.peer_id, peer.id, gid),
                     div.state.current_term, prev, (), commit)
                 by_dest.setdefault(peer.id, []).append((gid, req))
+        for dest, items in by_dest.items():
+            name = str(dest)
+            self.confirm_sent[name] = \
+                self.confirm_sent.get(name, 0) + len(items)
 
         async def _send(dest, items) -> None:
             env = AppendEnvelope(tuple(req for _, req in items))

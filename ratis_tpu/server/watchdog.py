@@ -71,9 +71,19 @@ KIND_OVERLOAD = "overload"
 # campaign uses for injected faults.
 KIND_GREY_FOLLOWER = "grey-follower"
 KIND_GREY_RECOVERED = "grey-recovered"
+# Placement controller actuations (ratis_tpu.placement): every leadership
+# transfer or read-steering decision the policy loop executes journals a
+# rebalance event, paired with a rebalance-done close carrying the
+# outcome (success/failed/aborted) through the same fault-correlation id
+# the chaos/grey pairs use.  A rebalance without its done pair is an
+# actuation that never converged — the chaos rebalance_storm SLO and the
+# shell health subcommand both check the pairing.
+KIND_REBALANCE = "rebalance"
+KIND_REBALANCE_DONE = "rebalance-done"
 KINDS = (KIND_COMMIT_STALL, KIND_ELECTION_CHURN, KIND_FOLLOWER_LAG,
          KIND_STUCK_LANE, KIND_INJECTED_FAULT, KIND_FAULT_RECOVERED,
-         KIND_OVERLOAD, KIND_GREY_FOLLOWER, KIND_GREY_RECOVERED)
+         KIND_OVERLOAD, KIND_GREY_FOLLOWER, KIND_GREY_RECOVERED,
+         KIND_REBALANCE, KIND_REBALANCE_DONE)
 
 # consecutive flat samples (with pending requests) before a commit-stall
 # event is journaled: one flat interval is ordinary queueing, two is not

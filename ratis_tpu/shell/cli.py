@@ -285,12 +285,13 @@ async def cmd_health(args) -> int:
     # not a degradation; an unrecovered one fails health like an organic
     # event does
     recovered = {e.get("fault") for _a, e in all_events
-                 if e.get("kind") == "fault-recovered" and e.get("fault")}
+                 if e.get("kind") in ("fault-recovered", "rebalance-done")
+                 and e.get("fault")}
     shown = 0
     for address, e in all_events:
         kind = e.get("kind")
-        if kind == "fault-recovered":
-            continue  # shown through its injected pair below
+        if kind in ("fault-recovered", "rebalance-done"):
+            continue  # shown through its opening pair below
         if shown == 0:
             print("watchdog events:")
         shown += 1
@@ -298,8 +299,15 @@ async def cmd_health(args) -> int:
         if kind == "injected-fault" and e.get("fault") in recovered:
             print(f"  {address} {kind}{group} (recovered): {e['detail']}")
             continue
+        # placement actuations pair rebalance with rebalance-done the way
+        # chaos pairs injected-fault with fault-recovered: a converged
+        # actuation is history, a dangling one degrades health
+        if kind == "rebalance" and e.get("fault") in recovered:
+            print(f"  {address} {kind}{group} (converged): {e['detail']}")
+            continue
         rc = 1
-        tag = " UNRECOVERED" if kind == "injected-fault" else ""
+        tag = (" UNRECOVERED" if kind == "injected-fault"
+               else " UNCONVERGED" if kind == "rebalance" else "")
         print(f"  {address} {kind}{group}{tag}: {e['detail']}")
     return rc
 
@@ -419,6 +427,82 @@ async def cmd_lag(args) -> int:
     for dead in out.get("unreachable", []):
         rc = 1
         print(f"  UNREACHABLE {dead['address']}: {dead['error']}")
+    return rc
+
+
+async def cmd_rebalance(args) -> int:
+    """Placement plan over the whole fleet: scrape every server's
+    ``/lag`` ``/divisions?rollup=1`` ``/health`` ``/hotgroups`` into the
+    same ClusterSnapshot the in-server policy loop builds locally, run
+    the same PlacementPolicy, and print the plan with reasons.
+
+    ``--dry-run`` only prints (exit 0 = balanced, nothing to do; 2 =
+    the plan has actions — scriptable as "work exists").  Without it the
+    transfers are executed through the admin client (exit 0 = every
+    transfer succeeded, 1 = any failed); steering and repins are
+    in-server/advisory actions and are printed, never executed here."""
+    from ratis_tpu.metrics.aggregate import fetch_json
+    from ratis_tpu.placement import (ClusterSnapshot, PlacementPolicy,
+                                     view_from_payloads)
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints:
+        raise SystemExit("pass -endpoints host:port[,host:port...]")
+    views = []
+    for address in endpoints:
+        payloads = {}
+        for name, path in (("lag", "/lag"),
+                           ("rollup", "/divisions?rollup=1"),
+                           ("health", "/health"),
+                           ("hotgroups", "/hotgroups")):
+            try:
+                payloads[name] = await fetch_json(address, path,
+                                                  args.timeout)
+            except Exception:
+                payloads[name] = None  # telemetry-off / degraded server
+        if all(v is None for v in payloads.values()):
+            print(f"  UNREACHABLE {address}", file=sys.stderr)
+            return 1
+        views.append(view_from_payloads(**payloads))
+    policy = PlacementPolicy(hot_share=args.hot_share,
+                             grey_score=args.grey_score,
+                             hysteresis=args.hysteresis,
+                             max_transfers_per_round=args.max_transfers)
+    plan = policy.plan(ClusterSnapshot(views=tuple(views)))
+    print(f"placement plan over {len(views)} server(s): "
+          f"imbalance={plan.imbalance:g}, "
+          f"{len(plan.transfers())} transfer(s), "
+          f"{len(plan.steers())} steer(s), "
+          f"{len(plan.repins())} advisory repin(s)")
+    for line in plan.explain():
+        print(f"  {line}")
+    if not plan.transfers() and not plan.steers():
+        print("balanced: nothing to do")
+        return 0
+    if args.dry_run:
+        return 2
+    if not args.peers:
+        raise SystemExit("executing a plan needs -peers id=host:port,...")
+    peers = parse_peers(args.peers)
+    async with _new_client(peers, None) as probe:
+        groups = await probe.group_management().group_list(peers[0].id)
+    # plan groups carry display strings (str(RaftGroupId) is not
+    # parseable back) — resolve them against the server's group list
+    by_display = {str(g): g for g in groups}
+    rc = 0
+    for t in plan.transfers():
+        gid = by_display.get(t.group)
+        if gid is None:
+            print(f"  {t.group}: not hosted by {peers[0].id}, skipped")
+            rc = 1
+            continue
+        async with _new_client(peers, gid) as client:
+            reply = await client.admin().transfer_leadership(
+                RaftPeerId.value_of(t.to_peer),
+                timeout_ms=args.timeout * 1000.0)
+        print(f"  TRANSFER {t.group} -> {t.to_peer}: "
+              f"{'SUCCESS' if reply.success else reply.exception}")
+        if not reply.success:
+            rc = 1
     return rc
 
 
@@ -550,6 +634,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of host:port metrics endpoints")
     p.add_argument("-timeout", type=float, default=10.0, help="seconds")
     p.set_defaults(func=cmd_lag)
+
+    p = sub.add_parser(
+        "rebalance",
+        help="compute (and optionally execute) the placement plan the "
+             "in-server policy loop runs, from scraped endpoints")
+    p.add_argument("-endpoints", required=True,
+                   help="comma list of host:port metrics endpoints")
+    p.add_argument("-peers", default=None,
+                   help="comma list of id=host:port (needed to execute)")
+    p.add_argument("-dry-run", "--dry-run", action="store_true",
+                   dest="dry_run",
+                   help="print the plan only; exit 2 when actions exist")
+    p.add_argument("-hot-share", type=float, default=0.2, dest="hot_share",
+                   help="share_min floor marking a group hot")
+    p.add_argument("-grey-score", type=float, default=0.5,
+                   dest="grey_score",
+                   help="health score under which a peer is steered")
+    p.add_argument("-hysteresis", type=float, default=1.0,
+                   help="extra hot groups over fair share tolerated")
+    p.add_argument("-max-transfers", type=int, default=2,
+                   dest="max_transfers", help="transfer cap per round")
+    p.add_argument("-timeout", type=float, default=10.0, help="seconds")
+    p.set_defaults(func=cmd_rebalance)
 
     lo = sub.add_parser("local").add_subparsers(dest="sub", required=True)
     p = lo.add_parser("raftMetaConf")

@@ -2254,6 +2254,374 @@ async def run_zipf_fleet_bench(num_groups: int = 1024,
         }
 
 
+async def run_placement_bench(num_groups: int = 48,
+                              clients: int = 384,
+                              requests_per_client: int = 6,
+                              zipf_s: float = 1.2,
+                              pace_s: float = 0.25,
+                              transport: str = "tcp",
+                              num_servers: int = 3,
+                              seed: int = 23,
+                              element_limit: int = 48,
+                              hot_pins: int = 8,
+                              grey_delay_ms: int = 120,
+                              settle_s: float = 4.0) -> dict:
+    """Closed-loop placement rung (round 16): the zipf fleet with an
+    INDUCED hotspot and an INDUCED grey follower, measured back-to-back
+    with the placement controller OFF then ON.
+
+    Setup: pin the ``hot_pins`` hottest zipf groups' leaderships onto
+    server 0 (the hotspot every skewed deployment eventually grows) and
+    delay server N-1's append handling by ``grey_delay_ms`` per envelope
+    (the grey follower: up, acking, slow).  Leases are disabled so every
+    linearizable read rides a batched readIndex confirmation sweep — the
+    path steering actually gates.
+
+    Phase OFF drives the fleet and measures the hot-group write p99, the
+    pinned server's shed count, and the grey peer's share of
+    confirmation group-requests.  Then a PlacementController is armed on
+    every server (fast interval, low hot-share floor, zero hysteresis —
+    the storm tuning), given ``settle_s`` of load to act, and phase ON
+    re-measures the same numbers.  The controller earns its keep iff
+    hot p99 and shed drop and the grey confirmation share collapses
+    while the peer stays up."""
+    import bisect
+    import random
+
+    from ratis_tpu.placement import PlacementController
+    from ratis_tpu.protocol.admin import TransferLeadershipArguments
+    from ratis_tpu.protocol.requests import (RequestType, admin_request_type,
+                                             read_request_type)
+    from ratis_tpu.util import injection
+
+    keys = RaftServerConfigKeys.Serving
+    extra = {
+        RaftServerConfigKeys.Read.OPTION_KEY: "LINEARIZABLE",
+        # leases OFF: confirmation sweeps must actually fire, or there is
+        # nothing for the steering hook to steer
+        RaftServerConfigKeys.Read.LEADER_LEASE_ENABLED_KEY: "false",
+        RaftServerConfigKeys.Telemetry.ENABLED_KEY: "true",
+        RaftServerConfigKeys.Telemetry.INTERVAL_KEY: "250ms",
+        keys.ADMISSION_ENABLED_KEY: "true",
+        keys.PENDING_ELEMENT_LIMIT_KEY: str(element_limit),
+        keys.RETRY_AFTER_KEY: "40ms",
+    }
+    rng = random.Random(seed)
+    weights = [(r + 1) ** -zipf_s for r in range(num_groups)]
+    total_w = sum(weights)
+    cdf, acc = [], 0.0
+    for w in weights:
+        acc += w
+        cdf.append(acc / total_w)
+
+    async with _started_cluster(num_groups, True, transport=transport,
+                                num_servers=num_servers,
+                                extra_props=extra) as cluster:
+        client = cluster.factory.new_client_transport(cluster.properties)
+        hot_srv = cluster.servers[0]
+        grey_srv = cluster.servers[-1]
+        grey_name = str(grey_srv.peer_id)
+        by_id = {s.peer_id: s for s in cluster.servers}
+        admin_id = ClientId.random_id()
+
+        async def pin(group, target_srv) -> bool:
+            """Transfer ``group``'s leadership to ``target_srv`` (the
+            churn rung's NotLeader-following retry idiom)."""
+            leader_srv = cluster._leader_hint.get(group.group_id,
+                                                  cluster.servers[0])
+            if leader_srv is target_srv:
+                return True
+            args = TransferLeadershipArguments(str(target_srv.peer_id),
+                                               3000.0)
+            reply = None
+            for _attempt in range(2 * len(group.peers)):
+                req = RaftClientRequest(
+                    admin_id, leader_srv.peer_id, group.group_id,
+                    next(cluster._call_ids), Message(args.to_payload()),
+                    type=admin_request_type(
+                        RequestType.TRANSFER_LEADERSHIP),
+                    timeout_ms=5000.0)
+                try:
+                    reply = await client.send_request(leader_srv.address,
+                                                      req)
+                except (RaftException, asyncio.TimeoutError):
+                    reply = None
+                if reply is None:
+                    break
+                if reply.success:
+                    cluster._leader_hint[group.group_id] = target_srv
+                    return True
+                exc = reply.exception
+                if isinstance(exc, LeaderNotReadyException):
+                    await asyncio.sleep(0.1)
+                    continue
+                if isinstance(exc, NotLeaderException) \
+                        and exc.suggested_leader is not None:
+                    nxt = by_id.get(exc.suggested_leader.id)
+                    if nxt is target_srv:   # already there
+                        cluster._leader_hint[group.group_id] = target_srv
+                        return True
+                    leader_srv = nxt or leader_srv
+                    continue
+                break
+            return False
+
+        # the induced hotspot: every hot group's leadership on server 0
+        pinned = 0
+        for g in cluster.groups[:hot_pins]:
+            pinned += bool(await pin(g, hot_srv))
+
+        # the induced grey follower: delay its append HANDLING (inbound)
+        # — it stays up and acking, just slow, exactly the regime the lag
+        # ledger's health score exists to catch
+        delay_s = grey_delay_ms / 1e3
+
+        async def on_append(local_id, _remote_id, *_args):
+            if str(local_id).split("@")[0] == grey_name:
+                await asyncio.sleep(delay_s)
+
+        injection.put(injection.APPEND_ENTRIES, on_append)
+
+        def confirm_totals() -> tuple:
+            """(grey group-requests, all group-requests) across servers."""
+            grey_n = tot = 0
+            for s in cluster.servers:
+                rb = s.serving.read_batch
+                if rb is None:
+                    continue
+                for name, n in rb.confirm_sent.items():
+                    tot += n
+                    if name == grey_name:
+                        grey_n += n
+            return grey_n, tot
+
+        def steered_now() -> int:
+            return sum(s.read_steering.steered for s in cluster.servers)
+
+        def hot_adm_now() -> tuple:
+            """(shed, admitted) on the pinned hot server.  The rung's
+            shed metric is the FRACTION of intake shed: the ON phase
+            serves ops faster, so its offered per-second rate (and raw
+            intake) is higher — raw shed counts aren't comparable."""
+            a = hot_srv.serving.admission
+            return a.shed_total, a.admitted_total
+
+        async def one_op(client_id, gid, is_read, lat, stats) -> None:
+            server = cluster._leader_hint.get(gid, cluster.servers[0])
+            deadline = time.monotonic() + 60.0
+            t0 = time.monotonic()
+            while True:
+                req = RaftClientRequest(
+                    client_id, server.peer_id, gid,
+                    next(cluster._call_ids),
+                    Message.value_of(b"GET" if is_read else b"INCREMENT"),
+                    type=(read_request_type() if is_read
+                          else write_request_type()),
+                    timeout_ms=10_000.0)
+                try:
+                    reply = await client.send_request(server.address, req)
+                except (RaftException, asyncio.TimeoutError):
+                    reply = None
+                if reply is not None and reply.success:
+                    lat.append(time.monotonic() - t0)
+                    cluster._leader_hint[gid] = server
+                    return
+                if time.monotonic() > deadline:
+                    stats["failures"] += 1
+                    return
+                exc = reply.exception if reply is not None else None
+                if isinstance(exc, ResourceUnavailableException):
+                    stats["shed_seen"] += 1
+                    await asyncio.sleep(max(exc.retry_after_ms, 1) / 1e3)
+                elif isinstance(exc, NotLeaderException) \
+                        and exc.suggested_leader is not None:
+                    server = by_id.get(exc.suggested_leader.id, server)
+                else:
+                    idx = cluster.servers.index(server)
+                    server = cluster.servers[(idx + 1)
+                                             % len(cluster.servers)]
+                    await asyncio.sleep(0.01)
+
+        async def drive(n_clients: int, pace_s: float) -> dict:
+            """One measured fleet pass, OPEN LOOP: every client fires a
+            write+read pair every ``pace_s`` on a fixed schedule,
+            regardless of how slowly earlier pairs complete.  A closed
+            loop would offer MORE load to whichever configuration serves
+            faster, making the OFF/ON shed comparison meaningless; with
+            a fixed offered schedule, shed and p99 both measure the
+            placement, not the feedback.  Hot-group write latencies are
+            tracked separately (the hotspot p99 the rung is about)."""
+            stats = {"shed_seen": 0, "failures": 0}
+            hot_lat: list[float] = []
+            write_lat: list[float] = []
+            read_lat: list[float] = []
+            homes = [bisect.bisect_left(cdf, rng.random())
+                     for _ in range(n_clients)]
+
+            async def pair(client_id, gid, wlat) -> None:
+                await one_op(client_id, gid, False, wlat, stats)
+                await one_op(client_id, gid, True, read_lat, stats)
+
+            pairs: list = []
+            t0 = time.monotonic()
+
+            async def fleet_client(i: int) -> None:
+                client_id = ClientId.random_id()
+                rank = min(homes[i], num_groups - 1)
+                gid = cluster.groups[rank].group_id
+                wlat = hot_lat if rank < hot_pins else write_lat
+                for k in range(requests_per_client):
+                    # synchronized waves, deliberately NOT staggered: the
+                    # instantaneous burst a wave lands on the hot server
+                    # is what overflows its pending budget, so the shed
+                    # comparison tracks burst-vs-budget (placement), not
+                    # this box's service rate
+                    at = t0 + pace_s * k
+                    delay = at - time.monotonic()
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    pairs.append(asyncio.ensure_future(
+                        pair(client_id, gid, wlat)))
+
+            await asyncio.gather(*(fleet_client(i)
+                                   for i in range(n_clients)))
+            await asyncio.gather(*pairs)
+            elapsed = time.monotonic() - t0
+            hot_lat.sort()
+            nh = len(hot_lat)
+            return {
+                "elapsed": elapsed,
+                "writes_ok": nh + len(write_lat),
+                "reads_ok": len(read_lat),
+                "hot_writes": nh,
+                "hot_p99_s": (hot_lat[min(nh - 1, (nh * 99) // 100)]
+                              if nh else None),
+                **stats,
+            }
+
+        try:
+            # ------------------------------------------- phase OFF
+            grey0, tot0 = confirm_totals()
+            shed0, adm0 = hot_adm_now()
+            off = await drive(clients, pace_s)
+            grey1, tot1 = confirm_totals()
+            shed1, adm1 = hot_adm_now()
+            off_shed, off_adm = shed1 - shed0, adm1 - adm0
+            off_grey_frac = ((grey1 - grey0) / max(1, tot1 - tot0))
+
+            # ------------------------------- arm the control loop
+            ctrls = []
+            for s in cluster.servers:
+                # the armed tuning: score the induced laggard low enough
+                # to steer — at threshold 1 any link with an entry in
+                # flight counts, and only the delayed peer sustains that —
+                # and let single-digit-percent groups cross the hot floor
+                # (the storm scenario runs the same knobs)
+                s.engine.ledger.lag_threshold = 1
+                s.engine.ledger.up_window_ms = 8000
+                # hysteresis 1 (not the storm's 0): the bench measures
+                # CONVERGENCE — the plan must go quiet once balanced, not
+                # keep shuffling leaderships through the measured phase
+                # cooldown outlasts the measured window: a group moves at
+                # most ONCE (during settle) — the ON phase then measures
+                # the converged placement, with a mid-phase handover's
+                # election pause never polluting the p99/shed numbers
+                ctrl = PlacementController(
+                    s, interval_s=0.4, cooldown_s=60.0, max_per_round=2,
+                    hot_share=0.02, grey_score=0.5, hysteresis=1.0,
+                    steer_ttl_s=6.0, transfer_timeout_s=3.0)
+                ctrl.start()
+                s.placement = ctrl
+                ctrls.append(ctrl)
+            # settle under SUSTAINED full-fleet load: the controller only
+            # sees what the sketch/ledger/admission see — the ledger's
+            # active-link scoring needs commits in flight at its sample
+            # times, and the shed-rate transfer gate needs the hotspot
+            # actually overflowing its budget while rounds fire
+            deadline = time.monotonic() + settle_s
+            hard_stop = deadline + 2 * settle_s
+            while time.monotonic() < deadline:
+                await drive(clients, pace_s)
+                if time.monotonic() >= deadline \
+                        and time.monotonic() < hard_stop \
+                        and any(c.last_plan is not None
+                                and c.last_plan.transfers()
+                                for c in ctrls):
+                    # still actuating: give it one more pass (bounded) so
+                    # the ON phase measures the converged placement, not
+                    # the tail of the rebalance itself
+                    deadline = min(hard_stop,
+                                   time.monotonic() + settle_s / 2)
+
+            # freeze the placement for the measured phase: the loop stays
+            # live (steering is re-planned every round, so the grey peer
+            # stays deflected) but the transfer budget drops to zero — a
+            # handover's election pause landing INSIDE the measured
+            # window would swamp the p99 with a one-off artifact
+            for c in ctrls:
+                c.policy.max_transfers_per_round = 0
+
+            # -------------------------------------------- phase ON
+            grey2, tot2 = confirm_totals()
+            shed2, adm2 = hot_adm_now()
+            steer0 = steered_now()
+            on = await drive(clients, pace_s)
+            grey3, tot3 = confirm_totals()
+            shed3, adm3 = hot_adm_now()
+            on_shed, on_adm = shed3 - shed2, adm3 - adm2
+            on_grey_sends = grey3 - grey2
+            on_grey_frac = on_grey_sends / max(1, tot3 - tot2)
+            steered = steered_now() - steer0
+            transfers = sum(c.actuator.transfers_ok for c in ctrls)
+            plans = sum(c.rounds for c in ctrls)
+        finally:
+            for c in list(locals().get("ctrls") or ()):
+                await c.close()
+            for s in cluster.servers:
+                s.placement = None
+            injection.remove(injection.APPEND_ENTRIES)
+
+        hot_leads_after = sum(
+            1 for g in cluster.groups[:hot_pins]
+            if (d := hot_srv.divisions.get(g.group_id)) is not None
+            and d.is_leader())
+        p99_off = off["hot_p99_s"]
+        p99_on = on["hot_p99_s"]
+        return {
+            "groups": num_groups, "clients": clients, "zipf_s": zipf_s,
+            "transport": transport, "peers": num_servers,
+            "hot_pins_requested": hot_pins, "hot_pins": pinned,
+            "hot_leads_after": hot_leads_after,
+            "grey_peer": grey_name, "grey_delay_ms": grey_delay_ms,
+            "writes_ok_off": off["writes_ok"], "writes_ok_on": on["writes_ok"],
+            "reads_ok_off": off["reads_ok"], "reads_ok_on": on["reads_ok"],
+            "failures": off["failures"] + on["failures"],
+            "hotspot_p99_before_ms": (round(p99_off * 1e3, 2)
+                                      if p99_off else None),
+            "hotspot_p99_after_ms": (round(p99_on * 1e3, 2)
+                                     if p99_on else None),
+            "hotspot_p99_ratio": (round(p99_on / p99_off, 3)
+                                  if p99_on and p99_off else None),
+            "hot_shed_off": off_shed, "hot_shed_on": on_shed,
+            "hot_shed_frac_off": round(
+                off_shed / max(1, off_shed + off_adm), 4),
+            "hot_shed_frac_on": round(
+                on_shed / max(1, on_shed + on_adm), 4),
+            "grey_confirm_frac_off": round(off_grey_frac, 4),
+            "grey_confirm_frac_on": round(on_grey_frac, 4),
+            # of the confirmation group-requests the sweeps WOULD have
+            # aimed at the grey peer during ON, the fraction steering
+            # actually deflected
+            "grey_steer_frac": round(
+                steered / max(1, steered + on_grey_sends), 4),
+            "steered_reads": steered,
+            "transfers": transfers,
+            "plans_computed": plans,
+            "election_convergence_s": round(
+                cluster.election_convergence_s, 2),
+        }
+
+
 if __name__ == "__main__":
     if "--mp-server" in sys.argv:
         _mp_server_main()

@@ -40,6 +40,11 @@ SCANNED = (
     "ratis_tpu/server/watchdog.py",
     "ratis_tpu/server/pause_monitor.py",
     "ratis_tpu/metrics/timeseries.py",
+    # the placement control loop must stay O(servers + k): it scores the
+    # ledger/sketch rollups, never the division fleet
+    "ratis_tpu/placement/policy.py",
+    "ratis_tpu/placement/actuate.py",
+    "ratis_tpu/placement/controller.py",
 )
 
 # (file, qualified function) -> why this per-group walk is allowed to stay.

@@ -1,0 +1,452 @@
+"""Placement controller (ratis_tpu.placement): the plan engine's scoring
+rules, the payload -> view builder, read steering, the non-leader
+admission bypass, the /divisions rollup, the hibernated-transfer wake,
+the opt-in in-server loop (zero-cost off, journaled actuations on), the
+shell rebalance frontend, and the rebalance_storm chaos scenario."""
+
+import argparse
+import asyncio
+
+import pytest
+
+from minicluster import MiniCluster, fast_properties, run_with_new_cluster
+from ratis_tpu.placement.policy import (ClusterSnapshot, HotGroup,
+                                        PlacementPolicy, ServerView,
+                                        view_from_payloads)
+from ratis_tpu.server.read import ReadSteering
+
+
+def _hot(name, share_min, led=True, shard=None, gid=None):
+    return HotGroup(group=name, share=share_min + 0.05,
+                    share_min=share_min, led=led, shard=shard, gid=gid)
+
+
+def _view(peer, leading=0, hot=(), scores=None, grey=(), shed_rate=0.0,
+          shards=()):
+    return ServerView(peer=peer, leading=leading, hot_groups=tuple(hot),
+                      peer_scores=dict(scores or {}),
+                      grey_peers=frozenset(grey), shed_rate=shed_rate,
+                      shard_counts=tuple(shards))
+
+
+# ------------------------------------------------------------ plan engine
+
+def test_hot_fair_share_transfer_multi_view():
+    """A server leading more hot groups than fair share + hysteresis
+    sheds its hottest excess to the least-loaded healthy peer."""
+    policy = PlacementPolicy(hot_share=0.2, hysteresis=0.0,
+                             max_transfers_per_round=2)
+    s0 = _view("s0", leading=6,
+               hot=[_hot("g1", 0.5), _hot("g2", 0.3), _hot("g3", 0.25)],
+               scores={"s1": 1.0, "s2": 1.0})
+    s1 = _view("s1", leading=1, scores={"s0": 1.0, "s2": 1.0})
+    s2 = _view("s2", leading=2, scores={"s0": 1.0, "s1": 1.0})
+    plan = policy.plan(ClusterSnapshot(views=(s0, s1, s2)))
+    transfers = plan.transfers()
+    # hot set = 3, fair = ceil(3/3) = 1 -> excess 2, hottest first
+    assert [t.group for t in transfers] == ["g1", "g2"]
+    assert all(t.category == "hot-group" for t in transfers)
+    # least-loaded target ranks first
+    assert transfers[0].to_peer == "s1"
+    assert "fair share" in transfers[0].reason
+    assert plan.imbalance > 0
+
+
+def test_hysteresis_band_blocks_reverse_move():
+    """After one transfer lands the recipient is inside the hysteresis
+    band, so the reverse move never plans (the anti-ping-pong rule)."""
+    policy = PlacementPolicy(hot_share=0.2, hysteresis=1.0)
+    # two hot groups over two servers, one each: fair = 1, and even the
+    # view that leads 2 is inside fair + hysteresis = 2
+    s0 = _view("s0", leading=3, hot=[_hot("g1", 0.5), _hot("g2", 0.3)],
+               scores={"s1": 1.0}, shed_rate=5.0)
+    s1 = _view("s1", leading=2, scores={"s0": 1.0})
+    plan = policy.plan(ClusterSnapshot(views=(s0, s1)))
+    assert plan.transfers() == []
+
+
+def test_single_view_requires_admission_pressure():
+    """The in-server loop's single-view gate: hot excess without live
+    shedding plans nothing (sketch shares are self-relative, so the
+    recipient of a hot group would otherwise bounce it back)."""
+    policy = PlacementPolicy(hot_share=0.2, hysteresis=0.0)
+    hot = [_hot("g1", 0.6), _hot("g2", 0.3)]
+    idle = _view("s0", leading=4, hot=hot,
+                 scores={"s1": 1.0, "s2": 1.0}, shed_rate=0.0)
+    plan = policy.plan(ClusterSnapshot(views=(idle,)))
+    assert plan.transfers() == []
+    assert any("admission pressure" in n for n in plan.notes)
+
+    shedding = _view("s0", leading=4, hot=hot,
+                     scores={"s1": 1.0, "s2": 1.0}, shed_rate=12.0)
+    plan = policy.plan(ClusterSnapshot(views=(shedding,)))
+    # fair = ceil(2 hot / 3 servers) = 1 -> shed the hottest
+    assert [t.group for t in plan.transfers()] == ["g1"]
+
+
+def test_steer_targets_grey_and_low_score():
+    """Grey episodes steer first (sharper diagnosis), low health scores
+    steer next, steered peers are never transfer targets."""
+    policy = PlacementPolicy(hot_share=0.2, grey_score=0.5,
+                             hysteresis=0.0)
+    v = _view("s0", leading=4, hot=[_hot("g1", 0.5), _hot("g2", 0.4)],
+              scores={"s1": 0.2, "s2": 1.0, "s3": 0.9},
+              grey={"s1"}, shed_rate=3.0)
+    plan = policy.plan(ClusterSnapshot(views=(v,)))
+    steers = plan.steers()
+    assert [s.away_from for s in steers] == ["s1"]  # deduped: grey wins
+    assert "grey-follower" in steers[0].reason
+    # s1 steered AND under grey-score: transfers go to s2 (score 1.0)
+    assert all(t.to_peer in ("s2", "s3") for t in plan.transfers())
+
+    low = _view("s0", scores={"s1": 0.3, "s2": 1.0})
+    plan = policy.plan(ClusterSnapshot(views=(low,)))
+    assert [s.away_from for s in plan.steers()] == ["s1"]
+    assert "health score 0.30" in plan.steers()[0].reason
+
+
+def test_cooldown_exclude_and_round_cap():
+    """Excluded (cooling) groups and over-cap transfers are skipped WITH
+    a note each, so a dry-run shows exactly what the loop would defer."""
+    policy = PlacementPolicy(hot_share=0.1, hysteresis=0.0,
+                             max_transfers_per_round=1)
+    # 4 hot over 4 servers: fair = 1, excess = 3 -> g1, g2, g3 planned
+    v = _view("s0", leading=6,
+              hot=[_hot("g1", 0.4), _hot("g2", 0.3), _hot("g3", 0.2),
+                   _hot("g4", 0.15)],
+              scores={"s1": 1.0, "s2": 1.0, "s3": 1.0}, shed_rate=2.0)
+    plan = policy.plan(ClusterSnapshot(views=(v,)), exclude={"g1"})
+    assert [t.group for t in plan.transfers()] == ["g2"]
+    assert any("g1: in cooldown" in n for n in plan.notes)
+    assert any("max-transfers-per-round" in n for n in plan.notes)
+
+
+def test_no_healthy_target_plans_nothing():
+    policy = PlacementPolicy(hot_share=0.1, grey_score=0.5,
+                             hysteresis=0.0)
+    v = _view("s0", leading=3, hot=[_hot("g1", 0.6), _hot("g2", 0.3)],
+              scores={"s1": 0.1, "s2": 0.2}, shed_rate=9.0)
+    plan = policy.plan(ClusterSnapshot(views=(v,)))
+    assert plan.transfers() == []
+    assert any("no healthy transfer target" in n for n in plan.notes)
+
+
+def test_leader_imbalance_fallback_multi_view_only():
+    """With nothing over the hot-share floor, a raw leadership spread
+    beyond hysteresis plans ONE corrective move — multi-view only (a
+    single view cannot see the spread)."""
+    policy = PlacementPolicy(hot_share=0.9, hysteresis=1.0)
+    s0 = _view("s0", leading=9, hot=[_hot("busy", 0.1)],
+               scores={"s1": 1.0})
+    s1 = _view("s1", leading=1, scores={"s0": 1.0})
+    plan = policy.plan(ClusterSnapshot(views=(s0, s1)))
+    transfers = plan.transfers()
+    assert len(transfers) == 1
+    assert transfers[0].category == "leader-imbalance"
+    assert transfers[0].group == "busy"
+    assert transfers[0].to_peer == "s1"
+    assert plan.imbalance > 0
+
+    solo = policy.plan(ClusterSnapshot(views=(s0,)))
+    assert solo.transfers() == []
+
+
+def test_shard_skew_advisory_repin():
+    policy = PlacementPolicy()
+    v = _view("s0", hot=[_hot("g1", 0.5, shard=0)], shards=(5, 1))
+    plan = policy.plan(ClusterSnapshot(views=(v,)))
+    repins = plan.repins()
+    assert len(repins) == 1
+    assert repins[0].group == "g1" and repins[0].shard == 1
+    # advisory: explain prints it, transfers/steers unaffected
+    assert any("REPIN (advisory)" in line for line in plan.explain())
+    assert plan.transfers() == [] and plan.steers() == []
+
+
+def test_plan_explain_and_to_dict():
+    policy = PlacementPolicy(hot_share=0.2, hysteresis=0.0)
+    v = _view("s0", leading=3, hot=[_hot("g1", 0.5, gid=object())],
+              scores={"s1": 0.2, "s2": 1.0}, shed_rate=1.0)
+    plan = policy.plan(ClusterSnapshot(views=(v,)))
+    lines = plan.explain()
+    assert any(line.startswith("STEER reads away from s1") for line in lines)
+    d = plan.to_dict()
+    assert d["imbalance"] == plan.imbalance
+    assert d["explain"] == lines
+    # gid objects never serialize into the payload
+    for a in d["actions"]:
+        assert "gid" not in a and a["kind"] in ("transfer", "steer",
+                                                "repin")
+
+
+def test_view_from_payloads_tolerates_partial():
+    """The shell builder: any payload subset (telemetry-off servers 404
+    /hotgroups), peer name recovered from whichever payload has it."""
+    lag = {"peer": "s0", "leading": 7,
+           "peers": [{"peer": "s1", "score": 0.4},
+                     {"peer": "s2", "score": 1.0}],
+           "groups": [{"group": "g9", "lag": 100}]}
+    rollup = {"peer": "s0", "leading": 7, "pendingTotal": 11,
+              "divisions": 16, "shards": [8, 8]}
+    health = {"peer": "s0", "divisions": 16,
+              "serving": {"shedTotal": 42, "pendingCount": 11}}
+    hotgroups = {"peer": "s0", "groups": [
+        {"group": "g1", "share": 0.5, "share_min": 0.45, "led": True,
+         "shard": 0}]}
+    v = view_from_payloads(health=health, lag=lag, hotgroups=hotgroups,
+                           rollup=rollup)
+    assert v.peer == "s0" and v.leading == 7
+    assert v.pending_total == 11 and v.shed_total == 42
+    assert v.shard_counts == (8, 8)
+    assert v.peer_scores == {"s1": 0.4, "s2": 1.0}
+    assert v.hot_groups[0].group == "g1"
+    assert v.laggard_groups[0]["group"] == "g9"
+
+    sparse = view_from_payloads(lag={"peer": "s1", "leading": 2})
+    assert sparse.peer == "s1" and sparse.hot_groups == ()
+
+
+# ---------------------------------------------------------- read steering
+
+def test_read_steering_episode_semantics():
+    rs = ReadSteering()
+    assert rs.avoided(now=0.0) == set()
+    assert rs.steer("s2", 5.0, now=0.0) is True      # new episode
+    assert rs.steer("s2", 5.0, now=1.0) is False     # silent renewal
+    assert rs.avoided(now=2.0) == {"s2"}
+    assert rs.avoided(now=7.0) == set()              # ttl expired
+    assert rs.steer("s2", 5.0, now=8.0) is True      # new episode again
+    rs.clear("s2")
+    assert rs.avoided(now=8.5) == set()
+
+
+# ----------------------------------------------- server integration layer
+
+def _admission_properties(element_limit=0):
+    p = fast_properties()
+    p.set("raft.tpu.serving.admission.enabled", "true")
+    p.set("raft.tpu.serving.admission.pending.element-limit",
+          str(element_limit))
+    return p
+
+
+def test_non_leader_admission_bypass():
+    """Requests for groups a server does NOT lead bypass the pending
+    budget: the division's NotLeader redirect must reach the client (a
+    shed here would trap clients of a just-transferred group in
+    retry-after loops against the old leader)."""
+    from ratis_tpu.protocol.requests import write_request_type
+
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        leader_srv = cluster.servers[leader.member_id.peer_id]
+        follower_srv = next(s for s in cluster.servers.values()
+                            if s is not leader_srv)
+        req = cluster._request(leader_srv.peer_id, b"INCREMENT",
+                               write_request_type())
+        # element-limit 0: the leader sheds every data-plane request...
+        shed, ticket = leader_srv.serving.admission.try_admit(req)
+        assert shed is not None and ticket is None
+        assert not shed.success
+        # ...but the follower lets the same request through to its
+        # division, which will answer NotLeader with the redirect hint
+        req2 = cluster._request(follower_srv.peer_id, b"INCREMENT",
+                                write_request_type())
+        shed2, ticket2 = follower_srv.serving.admission.try_admit(req2)
+        assert shed2 is None and ticket2 is None
+
+    run_with_new_cluster(3, body, properties=_admission_properties())
+
+
+def test_divisions_rollup_payload():
+    async def body(cluster: MiniCluster):
+        leader = await cluster.wait_for_leader()
+        srv = cluster.servers[leader.member_id.peer_id]
+        rollup = srv.divisions_info(query={"rollup": ["1"]})
+        assert rollup["peer"] == str(srv.peer_id)
+        assert rollup["divisions"] == 1 and rollup["leading"] == 1
+        assert sum(rollup["shards"]) == 1
+        assert rollup["pendingTotal"] == 0
+        assert rollup["hibernating"] == 0
+        # without the flag the full per-division list is unchanged
+        full = srv.divisions_info()
+        assert isinstance(full, list) and len(full) == 1
+
+    run_with_new_cluster(3, body)
+
+
+def test_transfer_leadership_wakes_hibernated_group():
+    """A transfer targeting a hibernated group must wake it first: a
+    sleeping leader sends no heartbeats and its followers hold no armed
+    election timers, so the handover would stall against them."""
+    from ratis_tpu.conf.keys import RaftServerConfigKeys
+    from ratis_tpu.protocol.admin import TransferLeadershipArguments
+    from ratis_tpu.protocol.message import Message
+    from ratis_tpu.protocol.requests import (RequestType,
+                                             admin_request_type)
+
+    p = fast_properties()
+    p.set(RaftServerConfigKeys.Hibernate.ENABLED_KEY, "true")
+    p.set(RaftServerConfigKeys.Hibernate.AFTER_SWEEPS_KEY, "2")
+
+    async def body(cluster: MiniCluster):
+        assert (await cluster.send_write()).success
+        deadline = asyncio.get_event_loop().time() + 20.0
+        leader = None
+        while asyncio.get_event_loop().time() < deadline:
+            leader = next((d for d in cluster.divisions()
+                           if d.hibernating), None)
+            if leader is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert leader is not None, "group never hibernated"
+        target = next(d for d in cluster.divisions()
+                      if d is not leader).member_id.peer_id
+        args = TransferLeadershipArguments(str(target), 5000.0)
+        reply = await cluster.send(
+            args.to_payload(),
+            admin_request_type(RequestType.TRANSFER_LEADERSHIP),
+            server_id=leader.member_id.peer_id, timeout=20.0)
+        assert reply.success, reply.exception
+        assert not leader.hibernating
+        new_leader = await cluster.wait_for_leader()
+        assert new_leader.member_id.peer_id == target
+
+    run_with_new_cluster(3, body, properties=p)
+
+
+def test_controller_off_by_default_on_when_enabled():
+    """Unset key -> no controller object, no /placement route, empty
+    steering (zero-cost).  Enabled -> the loop runs, a forced round
+    journals paired rebalance events for its steering actuation, and
+    GET /placement serves the explained plan."""
+
+    async def off_body(cluster: MiniCluster):
+        await cluster.wait_for_leader()
+        for s in cluster.servers.values():
+            assert s.placement is None
+            assert s.read_steering.avoided() == set()
+
+    run_with_new_cluster(3, off_body)
+
+    p = fast_properties()
+    p.set("raft.tpu.placement.enabled", "true")
+    p.set("raft.tpu.placement.interval", "60s")  # rounds forced by hand
+
+    async def on_body(cluster: MiniCluster):
+        from ratis_tpu.server.watchdog import (KIND_REBALANCE,
+                                               KIND_REBALANCE_DONE)
+        leader = await cluster.wait_for_leader()
+        srv = cluster.servers[leader.member_id.peer_id]
+        ctrl = srv.placement
+        assert ctrl is not None
+        await ctrl.round()
+        assert ctrl.rounds == 1 and ctrl.last_plan is not None
+        info = ctrl.placement_info()
+        assert info["enabled"] and info["rounds"] == 1
+        assert info["lastPlan"]["explain"] == ctrl.last_plan.explain()
+
+        # inject a grey episode; the next round must steer away from it
+        grey = next(name for name in
+                    (str(peer.id) for peer in cluster.group.peers)
+                    if name != str(srv.peer_id))
+        srv.watchdog._grey.add(grey)
+        await ctrl.round()
+        assert grey in srv.read_steering.avoided()
+        events = srv.watchdog.events()
+        opened = [e for e in events if e["kind"] == KIND_REBALANCE]
+        closed = [e for e in events if e["kind"] == KIND_REBALANCE_DONE]
+        assert opened and {e["fault"] for e in opened} \
+            == {e["fault"] for e in closed}
+        # renewal inside the active ttl journals nothing new
+        await ctrl.round()
+        assert len([e for e in srv.watchdog.events()
+                    if e["kind"] == KIND_REBALANCE]) == len(opened)
+
+    run_with_new_cluster(3, on_body, properties=p)
+
+
+# ------------------------------------------------------- shell rebalance
+
+def test_shell_rebalance_dry_run(monkeypatch, capsys):
+    """The scraped frontend: canned endpoint payloads -> the same policy
+    -> printed plan with reasons; exit 2 = work exists, 0 = balanced."""
+    from ratis_tpu.metrics import aggregate
+    from ratis_tpu.shell.cli import cmd_rebalance
+
+    def payloads(peer, leading, hot=(), scores=()):
+        return {
+            "/lag": {"peer": peer, "leading": leading,
+                     "peers": [{"peer": n, "score": s} for n, s in scores],
+                     "groups": []},
+            "/divisions?rollup=1": {"peer": peer, "leading": leading,
+                                    "pendingTotal": 0, "divisions": 8,
+                                    "shards": [8]},
+            "/health": {"peer": peer, "divisions": 8, "serving": {}},
+            "/hotgroups": {"peer": peer, "groups": list(hot)},
+        }
+
+    fleet = {
+        "h0:1": payloads("s0", 6, hot=[
+            {"group": "g1", "share": 0.6, "share_min": 0.5, "led": True},
+            {"group": "g2", "share": 0.3, "share_min": 0.25, "led": True},
+        ], scores=[("s1", 1.0)]),
+        "h1:1": payloads("s1", 1, scores=[("s0", 1.0)]),
+    }
+
+    async def fake_fetch(address, path, timeout):
+        return fleet[address][path]
+
+    monkeypatch.setattr(aggregate, "fetch_json", fake_fetch)
+    args = argparse.Namespace(endpoints="h0:1,h1:1", dry_run=True,
+                              peers=None, hot_share=0.2, grey_score=0.5,
+                              hysteresis=0.0, max_transfers=2,
+                              timeout=5.0)
+    rc = asyncio.run(cmd_rebalance(args))
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "placement plan over 2 server(s)" in out
+    assert "TRANSFER g1 -> s1" in out and "fair share" in out
+
+    # a balanced fleet: nothing to do, exit 0
+    fleet["h0:1"] = payloads("s0", 1, scores=[("s1", 1.0)])
+    rc = asyncio.run(cmd_rebalance(args))
+    assert rc == 0
+    assert "balanced: nothing to do" in capsys.readouterr().out
+
+
+# ------------------------------------------------------- chaos scenario
+
+@pytest.mark.chaos
+def test_rebalance_storm_scenario():
+    """The rebalance_storm chaos scenario: the placement controller runs
+    armed (fast rounds, zero hysteresis) WHILE faults fire; the standing
+    oracles hold (zero lost acks, exactly-once apply) and every
+    rebalance actuation the controller opened has its rebalance-done
+    pair on the surviving journals."""
+    from ratis_tpu.chaos.cluster import ChaosCluster, chaos_properties
+    from ratis_tpu.chaos.scenario import run_scenario
+    from ratis_tpu.chaos.scenarios import build_scenario
+
+    async def main():
+        p = chaos_properties(8, seed=7)
+        cluster = ChaosCluster(3, 8, properties=p, sm="counter", seed=7)
+        await cluster.start()
+        try:
+            cfg = {"servers": 3, "groups": 8, "writers": 4,
+                   "active_groups": 8, "sm": "counter",
+                   "convergence_s": 30.0, "recovery_s": 60.0,
+                   "min_acked": 20}
+            scenario = build_scenario("rebalance_storm", 7, cfg)
+            result = await run_scenario(cluster, scenario)
+            assert result.passed, (
+                f"[seed 7] rebalance_storm failed: {result.error}\n"
+                f"journal: {result.journal}")
+            assert result.checks.get("rebalance_events", 0) >= 1
+            assert (result.checks.get("rebalance_done", 0)
+                    >= result.checks.get("rebalance_events", 0))
+            assert result.acked > 20
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())

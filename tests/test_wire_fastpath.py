@@ -451,6 +451,9 @@ def test_bench_summary_line_fits_driver_window():
                        cps_before=123456.8),
         zipf=rung(writes_per_sec=123456.8, reads_per_sec=123456.8,
                   shed_frac=0.9999),
+        placement={"hotspot_p99_before_ms": 99999.99,
+                   "hotspot_p99_after_ms": 99999.99,
+                   "transfers": 99999, "grey_steer_frac": 0.9999},
         win_sweep={str(d): [123456.8, 99999.99, 0.9999]
                    for d in (1, 4, 16)},
         chaos={"passed": 9, "total": 9, "worst_reelect_s": 9999.999,
@@ -483,6 +486,10 @@ def test_bench_summary_line_fits_driver_window():
     # round-12 zipf fleet rung: [writes/s, reads/s, shed frac, p99 ms]
     assert parsed["secondary"]["zipf"] == [
         123456.8, 123456.8, 0.9999, 99999.99]
+    # round-16 placement closed loop: [hot p99 OFF, ON, transfers,
+    # grey steer fraction]
+    assert parsed["secondary"]["placement"] == [
+        99999.99, 99999.99, 99999, 0.9999]
     # observability keys: [engine occupancy, watchdog event count,
     # reply-plane scheduling hops per commit (round-8 fan-out collapse),
     # append-window occupancy (round-9 pipelined windows), the round-11
