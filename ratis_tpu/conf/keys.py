@@ -1133,7 +1133,10 @@ class RaftServerConfigKeys:
         PLATFORM_DEFAULT = ""  # "" = jax default platform
         # Shard the resident engine state over this many local devices
         # (jax.sharding.Mesh over the group axis; ratis_tpu.parallel.mesh).
-        # 0 = single-device.  The mesh size must divide max-groups.
+        # 0 = single-device.  Each device owns one contiguous slice of the
+        # group batch and receives only its slice's packed events; group
+        # capacity is auto-padded up to the next mesh multiple (padded
+        # rows stay masked invalid), so any max-groups value is legal.
         MESH_DEVICES_KEY = "raft.tpu.engine.mesh-devices"
         MESH_DEVICES_DEFAULT = 0
         # When set, the engine runs inside a jax.profiler trace written to

@@ -21,6 +21,7 @@ import asyncio
 import logging
 import threading
 import time
+import zlib
 from typing import Callable, Optional, Protocol
 
 import numpy as np
@@ -244,16 +245,26 @@ class QuorumEngine:
                  mesh=None, profile_dir: Optional[str] = None,
                  name: str = ""):
         # Optional jax.sharding.Mesh: the PRODUCTION resident tick
-        # (engine_step_resident / _fast, donated DeviceState) runs sharded
-        # over the group axis — each device owns G/n rows, packed events
-        # replicate, and the row-local quorum math keeps the step
+        # (engine_step_resident / _fast_sliced, donated DeviceState) runs
+        # sharded over the group axis — each device owns one contiguous
+        # SLICE of G/n rows, packed events are routed per slice ([7, S, E],
+        # slice axis sharded) so a device only scans events for rows it
+        # holds, and the row-local quorum math keeps the step
         # collective-free (ratis_tpu.parallel.mesh).
         self.mesh = mesh
+        n_slices = 1
+        if mesh is not None:
+            n_slices = int(mesh.devices.size)
+            # auto-pad: mesh size no longer needs to divide max-groups —
+            # padded rows stay ROLE_UNUSED and cost nothing
+            from ratis_tpu.parallel.mesh import pad_to_mesh
+            max_groups = pad_to_mesh(max_groups, n_slices)
         # SURVEY §5 tracing hook: when set, the engine runs inside a
         # jax.profiler trace (XLA device ops + named tick steps) written to
         # this directory for TensorBoard/xprof — raft.tpu.engine.profile-dir.
         self.profile_dir = profile_dir
-        self.state = GroupBatchState(max_groups, max_peers)
+        self.state = GroupBatchState(max_groups, max_peers,
+                                     n_slices=n_slices)
         self.clock = Clock()
         self.tick_interval_s = tick_interval_s
         self.scalar_fallback_threshold = scalar_fallback_threshold
@@ -325,9 +336,21 @@ class QuorumEngine:
 
     # -- registration --------------------------------------------------------
 
-    def attach(self, listener: EngineListener) -> int:
+    def slice_of(self, key: bytes) -> int:
+        """Owning mesh slice for a group id: the same crc32 pin as
+        LoopShardPool.shard_of, taken modulo the slice count — so whenever
+        the mesh size divides loop-shards, one slice maps to a whole
+        shard-set and intake for a slice's groups arrives from a stable
+        subset of loops."""
+        return zlib.crc32(key) % self.state.n_slices
+
+    def attach(self, listener: EngineListener,
+               slice_idx: int = -1) -> int:
+        """Register a listener; ``slice_idx`` pins the group's slot inside
+        one mesh slice's row range (divisions pass slice_of(group id);
+        -1 = lowest slice with room, the non-mesh default)."""
         with self._lock:
-            slot = self.state.allocate()
+            slot = self.state.allocate(slice_idx)
             self._listeners[slot] = listener
         try:
             self._listener_loops[slot] = asyncio.get_running_loop()
@@ -999,10 +1022,13 @@ class QuorumEngine:
                self.mesh.axis_names)
         steps = _SHARDED_STEPS.get(key)
         if steps is None:
-            from ratis_tpu.parallel.mesh import (sharded_resident_fast_step,
-                                                 sharded_resident_step)
+            from ratis_tpu.parallel.mesh import (
+                sharded_resident_fast_step_sliced, sharded_resident_step)
+            # fast path: the SLICED variant — events pre-routed per device
+            # ([7, S, E]) instead of replicated; refresh path keeps
+            # replicated inputs (dirty rows are rare and whole-row)
             steps = (sharded_resident_step(self.mesh),
-                     sharded_resident_fast_step(self.mesh))
+                     sharded_resident_fast_step_sliced(self.mesh))
             _SHARDED_STEPS[key] = steps
         return steps
 
@@ -1098,6 +1124,49 @@ class QuorumEngine:
                 evp[6, k + i] = deadline
         return evp
 
+    def _pack_tick_sliced(self, acks, updates: dict) -> np.ndarray:
+        """Slice-routed fast-tick packing: [7, S, E] with SLICE-LOCAL row
+        indices (ops.quorum.engine_step_resident_fast_sliced).  Each mesh
+        device receives only its slice's [7, 1, E] plane; E is the bucket
+        of the FULLEST slice, so a balanced intake ships ~1/S of the flat
+        pack's columns per device."""
+        s = self.state
+        n_slices, rows = s.n_slices, s.slice_rows
+        na = len(acks)
+        a = np.asarray(acks, np.int32).reshape(na, 4)  # slot,peer,match,t
+        asl = a[:, 0] // rows
+        ack_counts = np.bincount(asl, minlength=n_slices)
+        counts = ack_counts.copy()
+        for slot in updates:
+            counts[slot // rows] += 1
+        n = int(counts.max()) if n_slices else 0
+        ecap = self._bucket(n)
+        self._last_event_rows, self._last_event_cap = n, ecap
+        evp = np.full((7, n_slices, ecap), _PACK_SENTINEL, np.int32)
+        evp[0] = 0
+        evp[1] = 0
+        evp[4] = 0
+        if na:
+            order = np.argsort(asl, kind="stable")
+            srt, ssl = a[order], asl[order]
+            starts = np.concatenate(
+                ([0], np.cumsum(ack_counts)[:-1])).astype(np.int64)
+            col = np.arange(na) - starts[ssl]
+            evp[0, ssl, col] = srt[:, 0] % rows
+            evp[1, ssl, col] = srt[:, 1]
+            evp[2, ssl, col] = srt[:, 2]
+            evp[3, ssl, col] = srt[:, 3]
+            evp[4, ssl, col] = 1
+        cur = ack_counts.copy()
+        for slot, (flush, deadline) in updates.items():
+            sl = slot // rows
+            c = int(cur[sl])
+            cur[sl] += 1
+            evp[0, sl, c] = slot % rows
+            evp[5, sl, c] = flush
+            evp[6, sl, c] = deadline
+        return evp
+
     # Hard ceiling on one dispatch's event bucket (64 * 4^4).  A backlog
     # tick must NEVER exceed the largest COMPILED bucket: the next bucket
     # would be a brand-new jit shape, and that compile (measured minutes
@@ -1169,7 +1238,12 @@ class QuorumEngine:
             self._m.fast_ticks.inc()
             step = self._fast_kernel()
             updates, self._slot_updates = self._slot_updates, {}
-            res = step(self._dev, jnp.asarray(self._pack_tick(acks, updates)),
+            # mesh: slice-routed [7, S, E] planes for the sliced kernel;
+            # single device: the flat [7, E] pack
+            ev = (self._pack_tick_sliced(acks, updates)
+                  if self.mesh is not None
+                  else self._pack_tick(acks, updates))
+            res = step(self._dev, jnp.asarray(ev),
                        jnp.asarray(np.array(
                            [now, self.leadership_timeout_ms], np.int32)))
             self._dev = res.state

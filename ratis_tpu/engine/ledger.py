@@ -29,23 +29,32 @@ from ratis_tpu.engine.roles import ROLE_LEADER
 
 LOG = logging.getLogger(__name__)
 
-# module-level jit cache: (num_peers,) -> jitted ledger_pass.  Shapes
-# (G, P) key the underlying XLA cache as usual; num_peers is the only
-# static python arg.
+# module-level jit cache: (num_peers, mesh key) -> jitted ledger_pass.
+# Shapes (G, P) key the underlying XLA cache as usual; num_peers is the
+# only static python arg.  Mesh engines get the group-axis-sharded
+# variant (parallel.mesh.sharded_ledger_pass) so the telemetry pass
+# honors the same slice layout as the resident tick.
 _JITTED: dict = {}
 
 
-def _jitted_pass(num_peers: int):
-    fn = _JITTED.get(num_peers)
+def _jitted_pass(num_peers: int, mesh=None):
+    key = (num_peers,
+           None if mesh is None else
+           (tuple(d.id for d in mesh.devices.flat), mesh.axis_names))
+    fn = _JITTED.get(key)
     if fn is None:
-        import functools
+        if mesh is not None:
+            from ratis_tpu.parallel.mesh import sharded_ledger_pass
+            fn = sharded_ledger_pass(mesh, num_peers)
+        else:
+            import functools
 
-        import jax
+            import jax
 
-        from ratis_tpu.ops import ledger as ops
-        fn = jax.jit(functools.partial(ops.ledger_pass,
-                                       num_peers=num_peers))
-        _JITTED[num_peers] = fn
+            from ratis_tpu.ops import ledger as ops
+            fn = jax.jit(functools.partial(ops.ledger_pass,
+                                           num_peers=num_peers))
+        _JITTED[key] = fn
     return fn
 
 
@@ -162,7 +171,7 @@ class LagLedger:
         prev_valid = self._prev_gen == gen
         from ratis_tpu.ops.ledger import LAG_BUCKETS, pack_slices
         t0 = time.perf_counter()
-        packed = np.asarray(_jitted_pass(width)(
+        packed = np.asarray(_jitted_pass(width, self.engine.mesh)(
             st.role, st.match_index, commit, st.applied_index,
             st.conf_cur, st.conf_old, st.self_mask, st.last_ack_ms,
             st.peer_index, self._prev_commit, prev_valid,

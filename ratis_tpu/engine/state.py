@@ -25,8 +25,21 @@ NO_DEADLINE = np.iinfo(np.int32).max
 
 
 class GroupBatchState:
-    def __init__(self, max_groups: int = 1024, max_peers: int = 8):
+    def __init__(self, max_groups: int = 1024, max_peers: int = 8,
+                 n_slices: int = 1):
         g, p = max_groups, max_peers
+        # Mesh slicing (ratis_tpu.parallel.mesh): the capacity is split into
+        # ``n_slices`` contiguous row ranges, one per mesh device, and each
+        # group is pinned to a slot WITHIN its owning slice so the device
+        # that holds the rows also receives the group's packed events.
+        # With one slice (the default) allocation is exactly the old single
+        # free list.
+        self.n_slices = max(1, int(n_slices))
+        if g % self.n_slices:
+            raise ValueError(
+                f"capacity {g} not divisible by {self.n_slices} slices "
+                f"(pad with parallel.mesh.pad_to_mesh)")
+        self.slice_rows = g // self.n_slices
         self.capacity = g
         self.max_peers = p
         self.role = np.zeros(g, np.int8)
@@ -61,7 +74,12 @@ class GroupBatchState:
         self.pending_count = np.zeros(g, np.int32)
         self.peer_index = np.full((g, p), -1, np.int32)
         self.alloc_gen = np.zeros(g, np.int32)
-        self._free: list[int] = list(range(g - 1, -1, -1))
+        # One free list per slice over its contiguous row range (popped
+        # low-to-high, matching the historical single-list order).
+        self._free: list[list[int]] = [
+            list(range((i + 1) * self.slice_rows - 1,
+                       i * self.slice_rows - 1, -1))
+            for i in range(self.n_slices)]
         self.active: set[int] = set()
         # Slots whose host-side state changed since the last engine tick.
         # The device-resident tick uploads ONLY these rows (plus packed ack
@@ -72,10 +90,33 @@ class GroupBatchState:
         if slot >= 0:
             self.dirty.add(slot)
 
-    def allocate(self) -> int:
-        if not self._free:
-            self._grow()
-        slot = self._free.pop()
+    def slice_of_slot(self, slot: int) -> int:
+        return slot // self.slice_rows
+
+    def allocate(self, slice_idx: int = -1) -> int:
+        """Take a free slot.  ``slice_idx`` pins the slot to one mesh
+        slice's row range; -1 fills the lowest slice with room first —
+        sequential slot order 0,1,2,..., bit-identical to the unsliced
+        engine's historical allocation (mesh-vs-single identity tests
+        rely on this; production divisions always pass an explicit
+        slice)."""
+        if slice_idx < 0:
+            slice_idx = next(
+                (i for i in range(self.n_slices) if self._free[i]), 0)
+        free = self._free[slice_idx]
+        if not free:
+            if self.n_slices == 1:
+                self._grow()
+            else:
+                # Sliced capacity is FIXED at bring-up: the slot->slice map
+                # is positional, so growing would re-home every row.  The
+                # server auto-pads capacity to the mesh at construction;
+                # running out means the deployment is undersized.
+                raise RuntimeError(
+                    f"slice {slice_idx} out of group slots "
+                    f"({self.slice_rows} rows/slice, {self.n_slices} "
+                    f"slices); raise raft.tpu.engine.max-groups")
+        slot = free.pop()
         self.active.add(slot)
         self.alloc_gen[slot] += 1
         self.mark_dirty(slot)
@@ -97,7 +138,7 @@ class GroupBatchState:
         self.applied_index[slot] = -1
         self.pending_count[slot] = 0
         self.peer_index[slot] = -1
-        self._free.append(slot)
+        self._free[self.slice_of_slot(slot)].append(slot)
         self.mark_dirty(slot)
 
     def _grow(self) -> None:
@@ -126,8 +167,9 @@ class GroupBatchState:
             if name in ("match_index", "peer_index"):
                 b[old:] = -1
             setattr(self, name, b)
-        self._free.extend(range(new - 1, old - 1, -1))
+        self._free[0].extend(range(new - 1, old - 1, -1))
         self.capacity = new
+        self.slice_rows = new
 
     # -- per-group setters used by divisions --------------------------------
 

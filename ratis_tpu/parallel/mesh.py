@@ -7,8 +7,12 @@ independent groups, so the `[G, ...]` state arrays shard cleanly over a
 device mesh with NO cross-device collectives in the hot kernel (each
 group's quorum math is row-local; XLA's SPMD partitioner keeps the whole
 ``engine_step`` collective-free, so scaling is embarrassingly linear over
-ICI).  Host-side ack events are replicated to all devices; the scatter by
-group id resolves locally on the device that owns the row.
+ICI).  Host-side ack events travel one of two ways: the legacy path
+replicates them to all devices (the scatter by group id resolves locally
+on the device that owns the row), while the production fast tick routes
+each event to the owning slice's [7, S, E] plane
+(:func:`sliced_event_sharding`) so a device only ever scans the E/S
+columns that target rows it holds.
 
 These helpers build the mesh, the in/out shardings for
 :func:`ratis_tpu.ops.quorum.engine_step`, and a jitted sharded step —
@@ -115,6 +119,47 @@ def sharded_resident_fast_step(mesh):
         donate_argnums=(0,))
 
 
+def sliced_event_sharding(mesh):
+    """Sharding for the [7, S, E] pre-routed event planes of
+    :func:`ratis_tpu.ops.quorum.engine_step_resident_fast_sliced`: the
+    slice axis maps onto the group axis of the mesh, so each device
+    receives ONLY its own slice's packed events."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(None, GROUP_AXIS, None))
+
+
+def sharded_resident_fast_step_sliced(mesh):
+    """jit(engine_step_resident_fast_sliced) over ``mesh``: DeviceState
+    sharded + donated as in :func:`sharded_resident_fast_step`, but events
+    arrive slice-routed ([7, S, E], slice axis sharded) instead of
+    replicated — the production mesh tick.  Each device scatters only the
+    E/S event columns that target rows it owns; the partitioner keeps the
+    whole step collective-free."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ratis_tpu.ops.quorum import (ResidentFastStep,
+                                      engine_step_resident_fast_sliced)
+    repl = NamedSharding(mesh, P())
+    out_grp = NamedSharding(mesh, P(None, GROUP_AXIS))
+    return jax.jit(
+        engine_step_resident_fast_sliced,
+        in_shardings=(device_state_shardings(mesh),
+                      sliced_event_sharding(mesh), repl),
+        out_shardings=ResidentFastStep(device_state_shardings(mesh),
+                                       out_grp),
+        donate_argnums=(0,))
+
+
+def pad_to_mesh(groups: int, n_devices: int) -> int:
+    """Round a group capacity up to the next multiple of the mesh size.
+    Padded rows stay ROLE_UNUSED (masked invalid) and cost nothing; this
+    replaces the old hard requirement that ``mesh-devices`` divide
+    ``max-groups``."""
+    n = max(1, int(n_devices))
+    return -(-int(groups) // n) * n
+
+
 def sharded_resident_step(mesh):
     """jit(engine_step_resident): the dirty-row refresh variant of the
     resident tick, DeviceState sharded + donated; refresh rows and packed
@@ -133,6 +178,44 @@ def sharded_resident_step(mesh):
     out_shardings = ResidentStep(state_sh, grp, grp, grp, grp)
     return jax.jit(engine_step_resident, in_shardings=in_shardings,
                    out_shardings=out_shardings, donate_argnums=(0,))
+
+
+def sharded_ledger_pass(mesh, num_peers: int):
+    """jit(ops.ledger.ledger_pass) with the group axis sharded over
+    ``mesh``: the telemetry tick reads the same mesh-slice layout the
+    resident engine keeps, so a mesh deployment's observability pass
+    uploads each host-mirror slice to the device that owns it.  The
+    packed output replicates — its per-peer sections are cross-group
+    reductions, and collectives are fine OFF the hot path (integer sums
+    and exact-f32 counts, so the result is bit-identical to the
+    single-device pass; enforced in tests/test_lag_ledger.py)."""
+    import functools
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ratis_tpu.ops.ledger import ledger_pass
+    grp = NamedSharding(mesh, P(GROUP_AXIS))
+    grp_peer = NamedSharding(mesh, P(GROUP_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    in_shardings = (
+        grp,       # role
+        grp_peer,  # match_index
+        grp,       # commit_index
+        grp,       # applied_index
+        grp_peer,  # conf_cur
+        grp_peer,  # conf_old
+        grp_peer,  # self_mask
+        grp_peer,  # last_ack_ms
+        grp_peer,  # peer_index
+        grp,       # prev_commit
+        grp,       # prev_valid
+        repl,      # now_ms
+        repl,      # lag_threshold
+        repl,      # up_window_ms
+    )
+    return jax.jit(functools.partial(ledger_pass, num_peers=num_peers),
+                   in_shardings=in_shardings, out_shardings=repl)
 
 
 def shard_device_state(mesh, state):

@@ -306,6 +306,7 @@ class Division:
                                 if self.leader_ctx is not None else 0),
             "hibernating": bool(self._hibernating),
             "loopShard": self.server.shard_of_group(self.group_id),
+            "meshSlice": self.server.slice_of_group(self.group_id),
             "shardQueueDepth":
                 self.server.shard_queue_depth(self.group_id),
         }
@@ -326,7 +327,11 @@ class Division:
 
     def attach_engine(self) -> None:
         engine = self.server.engine
-        self.engine_slot = engine.attach(self)
+        # slice-aware slot pin: the group's rows land inside the mesh
+        # slice its crc32 hash owns, so its packed events route to the
+        # device that holds them (no-op without a mesh: one slice)
+        self.engine_slot = engine.attach(
+            self, engine.slice_of(self.group_id.to_bytes()))
         self._assign_peer_slots()
         self._sync_conf_to_engine()
         self._engine_set_applied()

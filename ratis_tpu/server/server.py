@@ -1015,6 +1015,13 @@ class RaftServer:
             return 0
         return self.shards.shard_of(group_id.to_bytes())
 
+    def slice_of_group(self, group_id: RaftGroupId) -> int:
+        """Mesh slice owning ``group_id``'s engine rows (0 without a
+        mesh).  Same crc32 hash as :meth:`shard_of_group`, so whenever
+        ``mesh-devices`` divides ``loop-shards`` one device slice is fed
+        by a stable subset of loop shards (one slice = one shard-set)."""
+        return self.engine.slice_of(group_id.to_bytes())
+
     def upkeep_plane_for(self, shard: int):
         """The loop shard's UpkeepPlane, or None when array mode is off
         (raft.tpu.upkeep.enabled unset) — callers fall back to the legacy
@@ -1066,6 +1073,7 @@ class RaftServer:
                 "freshBoundS": fresh_bound,
                 "groupsLive": len(self.engine.state.active),
                 "groupsCapacity": self.engine.state.capacity,
+                "meshSlices": self.engine.state.n_slices,
             },
             "watchdogEvents": (self.watchdog.event_count()
                                if self.watchdog is not None else 0),

@@ -125,8 +125,13 @@ def bench_properties(batched: bool, num_groups: int = 1,
     p.set(RaftServerConfigKeys.Gc.REFREEZE_INTERVAL_KEY, "15s")
     if mesh_devices:
         # shard the resident engine state over the group axis of an
-        # n-device mesh (parallel/mesh.py; the rung that gives sharding a
-        # measured e2e number, not just dryrun bit-identity)
+        # n-device mesh (parallel/mesh.py): each device owns one
+        # contiguous slice of the group batch, divisions are crc32-pinned
+        # to slots inside their owning slice, and the fast tick ships
+        # slice-routed [7, S, E] event planes instead of replicating the
+        # pack to every device (the rung that gives sharding a measured
+        # e2e number, not just dryrun bit-identity).  Capacity is
+        # auto-padded to the mesh, so num_groups needs no alignment.
         p.set(RaftServerConfigKeys.Engine.MESH_DEVICES_KEY,
               str(mesh_devices))
     if trace:

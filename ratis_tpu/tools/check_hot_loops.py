@@ -45,6 +45,11 @@ SCANNED = (
     "ratis_tpu/placement/policy.py",
     "ratis_tpu/placement/actuate.py",
     "ratis_tpu/placement/controller.py",
+    # the mesh plane sits INSIDE the tick: sharding helpers must stay
+    # pure jit-wrapper code — any divisions walk here would run per tick
+    # on the fast path
+    "ratis_tpu/parallel/__init__.py",
+    "ratis_tpu/parallel/mesh.py",
 )
 
 # (file, qualified function) -> why this per-group walk is allowed to stay.

@@ -38,6 +38,22 @@ def pytest_configure(config):
         "markers", "chaos: deterministic fault-scenario gate "
                    "(ratis_tpu.chaos); fast scenarios run in tier-1, the "
                    "long campaign also carries `slow`")
+    config.addinivalue_line(
+        "markers", "mesh: needs the multi-(virtual-)device fleet "
+                   "(XLA_FLAGS --xla_force_host_platform_device_count=8, "
+                   "set in-process above); tier-1 — mesh-vs-single-device "
+                   "bit-identity is a correctness gate, not a perf rung")
+
+
+def pytest_collection_modifyitems(config, items):
+    """`mesh` tests assert their device fleet up front: if the in-process
+    XLA flag was lost (stale interpreter, ambient override), fail loudly
+    at the marked tests instead of skipping the bit-identity gate."""
+    if not any(item.get_closest_marker("mesh") for item in items):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    assert "xla_force_host_platform_device_count" in flags, \
+        "mesh marker requires the conftest-set XLA_FLAGS device fleet"
 
 
 @pytest.fixture(autouse=True)

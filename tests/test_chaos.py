@@ -133,6 +133,44 @@ def test_shared_log_tail_loss_scenario():
         asyncio.run(main(tmp))
 
 
+@pytest.mark.chaos
+@pytest.mark.mesh
+def test_chaos_campaign_subset_mesh():
+    """PR-18 gate: a campaign subset with the MESH engine armed
+    (raft.tpu.engine.mesh-devices=2 on the virtual CPU fleet) — faults
+    bite the slice-routed packed-ack path, divisions pin to their crc32
+    slice, and the exactly-once counter oracle must still hold."""
+
+    async def main():
+        p = chaos_properties(8, seed=19)
+        p.set("raft.tpu.engine.mesh-devices", "2")
+        p.set("raft.tpu.engine.scalar-fallback-threshold", "0")
+        cluster = ChaosCluster(3, 8, properties=p, sm="counter", seed=19)
+        await cluster.start()
+        try:
+            for s in cluster.servers.values():
+                assert s.engine.mesh is not None
+                assert s.engine.state.n_slices == 2
+            cfg = {"servers": 3, "groups": 8, "writers": 4,
+                   "active_groups": 8, "sm": "counter",
+                   "convergence_s": 30.0, "recovery_s": 60.0,
+                   "min_acked": 20}
+            for name in ("partition_leader", "crash_restart_leader"):
+                scenario = build_scenario(name, 19, cfg)
+                result = await run_scenario(cluster, scenario)
+                assert result.passed, (
+                    f"[seed 19] mesh campaign {name} failed: "
+                    f"{result.error}\njournal: {result.journal}")
+            # the engines actually dispatched through the sliced kernel
+            for s in cluster.servers.values():
+                assert s.engine.metrics["fast_ticks"] > 0, \
+                    "[seed 19] mesh engine never ran the fast path"
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_chaos_campaign_long():

@@ -131,6 +131,77 @@ def test_ledger_pass_matches_python_reference():
                 f"[seed {seed}] section {name}: {got} != {want}"
 
 
+@pytest.mark.mesh
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_ledger_pass_mesh_bit_identical(n_devices):
+    """The group-axis-sharded ledger pass (parallel.mesh.sharded_ledger_pass,
+    what a mesh engine's telemetry tick runs) must produce the EXACT packed
+    int32 vector of the single-device pass on randomized state: every
+    aggregation is an integer sum / exact-f32 count / row-local argmax, so
+    sharding must not perturb a single bit."""
+    import jax
+
+    from ratis_tpu.parallel.mesh import make_group_mesh, sharded_ledger_pass
+    if len(jax.devices()) < n_devices:
+        pytest.skip(f"need {n_devices} devices")
+    g, p, w = 64, 5, 8
+    mesh_fn = sharded_ledger_pass(make_group_mesh(n_devices), w)
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        role = rng.choice([ROLE_UNUSED, ROLE_FOLLOWER, ROLE_LEADER],
+                          g).astype(np.int8)
+        commit = rng.integers(-1, 200, g).astype(np.int32)
+        match = rng.integers(-1, 200, (g, p)).astype(np.int32)
+        applied = rng.integers(-1, 200, g).astype(np.int32)
+        cur = rng.random((g, p)) < 0.7
+        old = rng.random((g, p)) < 0.2
+        selfm = np.zeros((g, p), bool)
+        selfm[np.arange(g), rng.integers(0, p, g)] = True
+        ack = rng.integers(0, 6000, (g, p)).astype(np.int32)
+        pidx = rng.integers(-1, w, (g, p)).astype(np.int32)
+        prev_commit = rng.integers(-1, 200, g).astype(np.int32)
+        prev_valid = rng.random(g) < 0.6
+        args = (role, match, commit, applied, cur, old, selfm, ack, pidx,
+                prev_commit, prev_valid, np.int32(5000), np.int32(4),
+                np.int32(3000))
+        plain = np.asarray(ledger_pass(*args, num_peers=w))
+        sharded = np.asarray(mesh_fn(*args))
+        assert (plain == sharded).all(), f"[seed {seed}] mesh-on != mesh-off"
+
+
+def test_ledger_sample_mesh_engine_matches_single():
+    """LagLedger.sample() through a mesh engine (sharded _jitted_pass) vs
+    the same host mirrors through a plain engine: identical LedgerSample
+    arrays — the telemetry plane must not notice the mesh."""
+    from ratis_tpu.parallel.mesh import make_group_mesh
+    e1 = _leader_engine(24)
+    e2 = QuorumEngine(max_groups=e1.state.capacity, max_peers=8,
+                      mesh=make_group_mesh(2), name="ledger-mesh")
+    try:
+        # mirror e1's scripted state into e2 wholesale (same slots)
+        for name in ("role", "match_index", "commit_index", "applied_index",
+                     "conf_cur", "conf_old", "self_mask", "last_ack_ms",
+                     "peer_index", "alloc_gen", "pending_count"):
+            getattr(e2.state, name)[...] = getattr(e1.state, name)
+        e2.state.active = set(e1.state.active)
+        e2.ledger.peer_names = list(e1.ledger.peer_names)
+        e2.ledger._peer_idx = dict(e1.ledger._peer_idx)
+        e2.clock = e1.clock
+        s1 = e1.ledger.sample()
+        s2 = e2.ledger.sample()
+        for field in ("gap", "delta", "worst_lag", "worst_peer", "hist",
+                      "peer_links", "peer_up", "peer_laggy", "peer_active",
+                      "peer_laggy_active", "peer_max_lag"):
+            a, b = getattr(s1, field), getattr(s2, field)
+            assert (a == b).all(), f"section {field} differs under mesh"
+        assert (s1.leading, s1.gap_total) == (s2.leading, s2.gap_total)
+    finally:
+        e1.ledger.unregister()
+        e1._m.unregister()
+        e2.ledger.unregister()
+        e2._m.unregister()
+
+
 def test_lag_histogram_bucket_units():
     """Bucket 0 = caught up; bucket i >= 1 = lag in [2^(i-1), 2^i) —
     exact at the power-of-two boundaries (a float log would misfile)."""

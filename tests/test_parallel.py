@@ -19,6 +19,35 @@ def _single_device_step(args):
     return jax.jit(engine_step)(*[jnp.asarray(a) for a in args])
 
 
+class _FakeClock:
+    def __init__(self):
+        self.t = 0
+
+    def now_ms(self):
+        return self.t
+
+    def advance_epoch(self, delta_ms):
+        self.t -= delta_ms
+
+
+class _Rec:
+    def __init__(self):
+        self.events = []
+
+    def on_commit_advance_now(self, c):
+        self.events.append(("commit", c))
+
+    async def on_commit_advance(self, c):
+        self.events.append(("commit", c))
+
+    async def on_election_timeout(self):
+        self.events.append("timeout")
+
+    async def on_leadership_stale(self):
+        self.events.append("stale")
+
+
+@pytest.mark.mesh
 @pytest.mark.parametrize("n_devices", [2, 4, 8])
 def test_sharded_step_matches_single_device(n_devices):
     mesh = make_group_mesh(n_devices)
@@ -32,6 +61,7 @@ def test_sharded_step_matches_single_device(n_devices):
             np.asarray(getattr(single, name)), err_msg=name)
 
 
+@pytest.mark.mesh
 def test_sharded_output_layout():
     mesh = make_group_mesh(8)
     args = _example_batch(num_groups=64, num_peers=8, num_events=16)
@@ -42,6 +72,7 @@ def test_sharded_output_layout():
     assert out.match_index.sharding.spec[0] == GROUP_AXIS
 
 
+@pytest.mark.mesh
 def test_shard_batch_rejects_indivisible():
     mesh = make_group_mesh(8)
     args = _example_batch(num_groups=12, num_peers=8, num_events=4)
@@ -64,6 +95,7 @@ def test_dryrun_entry_points():
     dryrun_multichip(8)
 
 
+@pytest.mark.mesh
 @pytest.mark.parametrize("n_devices", [2, 8])
 def test_sharded_resident_engine_bit_identical(n_devices):
     """The PRODUCTION resident path (QuorumEngine with mesh=..., donated
@@ -76,43 +108,17 @@ def test_sharded_resident_engine_bit_identical(n_devices):
     from ratis_tpu.engine.engine import QuorumEngine
     from ratis_tpu.engine.state import NO_DEADLINE, ROLE_FOLLOWER, ROLE_LEADER
 
-    class FakeClock:
-        def __init__(self):
-            self.t = 0
-
-        def now_ms(self):
-            return self.t
-
-        def advance_epoch(self, delta_ms):
-            self.t -= delta_ms
-
-    class Rec:
-        def __init__(self):
-            self.events = []
-
-        def on_commit_advance_now(self, c):
-            self.events.append(("commit", c))
-
-        async def on_commit_advance(self, c):
-            self.events.append(("commit", c))
-
-        async def on_election_timeout(self):
-            self.events.append("timeout")
-
-        async def on_leadership_stale(self):
-            self.events.append("stale")
-
     G = 16
 
     def build(mesh):
         eng = QuorumEngine(max_groups=G, max_peers=8,
                            scalar_fallback_threshold=0, use_device=True,
                            mesh=mesh)
-        eng.clock = FakeClock()
+        eng.clock = _FakeClock()
         recs = []
         s = eng.state
         for i in range(G):
-            rec = Rec()
+            rec = _Rec()
             slot = eng.attach(rec)
             recs.append((slot, rec))
             cur = np.zeros(8, bool)
@@ -167,6 +173,152 @@ def test_sharded_resident_engine_bit_identical(n_devices):
     asyncio.run(run_pair())
 
 
+@pytest.mark.mesh
+@pytest.mark.parametrize("n_devices,seed", [(2, 3), (8, 4), (8, 5)])
+def test_mesh_engine_randomized_churn_bit_identical(n_devices, seed):
+    """Randomized differential gate: the mesh engine must stay
+    OBSERVATIONALLY bit-identical to the single-device engine under a
+    seed-derived script of slot churn (attach/detach), demote/re-elect
+    flips, joint conf changes, and ack/flush/deadline traffic.  Raw slot
+    NUMBERS may legitimately diverge after churn (per-slice free lists vs
+    the flat list), so rows and event streams are compared per LISTENER —
+    the observable identity a division actually rides on."""
+    import asyncio
+
+    from ratis_tpu.engine.engine import QuorumEngine
+    from ratis_tpu.engine.state import NO_DEADLINE, ROLE_FOLLOWER, ROLE_LEADER
+
+    G, P = 24, 8
+    rng = np.random.default_rng(seed)
+
+    # ---- one engine-independent op script, derived only from the seed
+    script = []
+    alive = []
+    next_id = 0
+
+    def gen_attach():
+        nonlocal next_id
+        i = next_id
+        next_id += 1
+        alive.append(i)
+        script.append(("attach", i, 3 + int(rng.integers(0, 3))))
+
+    for _ in range(12):
+        gen_attach()
+    t = 0
+    for _round in range(6):
+        for _ in range(int(rng.integers(2, 6))):
+            kind = str(rng.choice(["detach", "attach", "demote", "elect",
+                                   "conf", "ack", "flush", "deadline"]))
+            if kind == "detach" and len(alive) > 4:
+                script.append(("detach",
+                               alive.pop(int(rng.integers(0, len(alive))))))
+                continue
+            if kind == "attach":
+                if len(alive) < G - 2:
+                    gen_attach()
+                continue
+            if not alive:
+                continue
+            i = alive[int(rng.integers(0, len(alive)))]
+            if kind == "demote":
+                script.append(("demote", i,
+                               t + 50 + int(rng.integers(0, 400))))
+            elif kind == "elect":
+                script.append(("elect", i))
+            elif kind == "conf":
+                cur = rng.random(P) < 0.5
+                cur[0] = True
+                old = np.zeros(P, bool)
+                if rng.random() < 0.4:
+                    old = rng.random(P) < 0.4
+                    old[0] = True
+                script.append(("conf", i, cur, old))
+            elif kind == "ack":
+                script.append(("ack", i, int(rng.integers(1, 4)),
+                               int(rng.integers(0, 64))))
+            elif kind == "flush":
+                script.append(("flush", i, int(rng.integers(0, 64))))
+            elif kind == "deadline":
+                script.append(("deadline", i,
+                               t + int(rng.integers(50, 600))))
+        t += int(rng.integers(40, 260))
+        script.append(("tick", t))
+    script.append(("tick", t + 2000))  # sweep every follower deadline
+
+    async def run_engine(mesh):
+        eng = QuorumEngine(max_groups=G, max_peers=P,
+                           scalar_fallback_threshold=0, use_device=True,
+                           mesh=mesh)
+        eng.clock = _FakeClock()
+        s = eng.state
+        recs = {}  # listener idx -> _Rec (kept after detach)
+        live = {}  # listener idx -> current slot
+        for op in script:
+            kind = op[0]
+            if kind == "attach":
+                _, i, voters = op
+                rec = _Rec()
+                slot = eng.attach(rec)
+                recs[i], live[i] = rec, slot
+                cur = np.zeros(P, bool)
+                cur[:voters] = True
+                s.set_conf(slot, 0, cur, np.zeros(P, bool),
+                           np.zeros(P, np.int32), 0)
+                s.role[slot] = ROLE_FOLLOWER
+                s.election_deadline_ms[slot] = NO_DEADLINE
+                s.mark_dirty(slot)
+            elif kind == "detach":
+                eng.detach(live.pop(op[1]))
+            elif kind == "demote":
+                slot = live[op[1]]
+                s.role[slot] = ROLE_FOLLOWER
+                s.election_deadline_ms[slot] = op[2]
+                s.mark_dirty(slot)
+            elif kind == "elect":
+                slot = live[op[1]]
+                s.role[slot] = ROLE_LEADER
+                s.last_ack_ms[slot, :3] = eng.clock.t
+                s.election_deadline_ms[slot] = NO_DEADLINE
+                s.mark_dirty(slot)
+            elif kind == "conf":
+                s.set_conf(live[op[1]], 0, op[2], op[3],
+                           np.zeros(P, np.int32), 0)
+            elif kind == "ack":
+                eng.on_ack(live[op[1]], op[2], op[3])
+            elif kind == "flush":
+                eng.on_flush(live[op[1]], op[2])
+            elif kind == "deadline":
+                eng.on_deadline(live[op[1]], op[2])
+            elif kind == "tick":
+                eng.clock.t = op[1]
+                await eng.tick()
+        return eng, recs, live
+
+    async def run_pair():
+        e1, r1, l1 = await run_engine(make_group_mesh(n_devices))
+        e2, r2, l2 = await run_engine(None)
+        assert set(r1) == set(r2) and set(l1) == set(l2)
+        for i in sorted(r1):
+            assert r1[i].events == r2[i].events, \
+                (i, r1[i].events, r2[i].events)
+        for i in sorted(l1):
+            s1, s2 = l1[i], l2[i]
+            for name in ("role", "match_index", "commit_index",
+                         "flush_index", "election_deadline_ms",
+                         "last_ack_ms", "conf_cur", "conf_old"):
+                np.testing.assert_array_equal(
+                    getattr(e1.state, name)[s1],
+                    getattr(e2.state, name)[s2],
+                    err_msg=f"listener {i} field {name}")
+        devs = {sh.device for sh in e1._dev.match_index.addressable_shards}
+        assert len(devs) == n_devices
+        assert e1.metrics["fast_ticks"] > 0
+
+    asyncio.run(run_pair())
+
+
+@pytest.mark.mesh
 def test_cluster_on_sharded_engine():
     """A full cluster with raft.tpu.engine.mesh-devices=8: elections,
     writes, and commit advancement all run through the group-sharded
@@ -178,7 +330,8 @@ def test_cluster_on_sharded_engine():
 
     p = batched_properties()
     p.set(RaftServerConfigKeys.Engine.MESH_DEVICES_KEY, "8")
-    # mesh size must divide the group capacity; default 1024 % 8 == 0
+    # capacity is auto-padded to the next mesh multiple (PR 18); the
+    # default 1024 is already a multiple of 8
 
     async def body(cluster: MiniCluster):
         leader = await cluster.wait_for_leader(timeout=30)

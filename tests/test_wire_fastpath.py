@@ -440,6 +440,9 @@ def test_bench_summary_line_fits_driver_window():
         kernel={"group_updates_per_sec": 1330708656.5,
                 "vs_scalar_loop": 99126.85, "platform": "TPU v5 lite0"},
         kernel_100k={"group_updates_per_sec_100k": 1333027867.0},
+        mesh100k={"groups": 102400, "devices": 8,
+                  "updates_per_s": 1333027867.9, "tick_ms": 99999.99,
+                  "efficiency_frac": 0.999},
         tpu_e2e={"dnf": True, "reason": "x" * 500},
         traced=rung(host_path_decomposition=decomp),
         filestore5=rung(streams_ok=32, stream_mb_per_s=99999.99),
@@ -510,6 +513,10 @@ def test_bench_summary_line_fits_driver_window():
     # kernel throughputs are COUNTS: emitted rounded to the integer
     assert parsed["secondary"]["kernel"][0] == 1330708656
     assert parsed["secondary"]["kernel_100k"] == 1333027867
+    # PR-18 flagship mesh rung: [groups, devices, updates/s, tick ms,
+    # efficiency vs the mesh-devices=0 control]
+    assert parsed["secondary"]["mesh100k"] == [
+        102400, 8, 1333027868, 99999.99, 0.999]
     # compact list forms: grpc_1024 = [cps, p99, scalar cps, s256 cps],
     # mesh_10240 = [cps, spread, sim cps, sim spread]
     assert parsed["secondary"]["grpc_1024"][0] == 123456.8
